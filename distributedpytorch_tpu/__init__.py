@@ -1,0 +1,18 @@
+"""distributedpytorch_tpu — a TPU-native (JAX/XLA/pjit) distributed training framework.
+
+A from-scratch, idiomatic-JAX rebuild of the capabilities of the reference
+``notnitsuj/DistributedPyTorch`` project (see SURVEY.md): UNet image
+segmentation trained under selectable parallelism strategies — single device,
+single-process data parallel (DP), multi-process data parallel with gradient
+all-reduce over ICI (DDP), a 2-stage microbatched pipeline (MP), and a
+DDP×Pipe hybrid on a 2-D device mesh.
+
+Design stance (SURVEY.md §7): ONE functional trainer parameterized by a
+strategy (mesh + shardings), not N copy-pasted training loops; NHWC layouts
+internally for TPU; XLA collectives (psum / sharding-propagated AllReduce)
+instead of NCCL; explicit GPipe schedule instead of async CUDA launches.
+"""
+
+__version__ = "0.1.0"
+
+from distributedpytorch_tpu.config import TrainConfig  # noqa: F401

@@ -1,0 +1,73 @@
+"""Run configuration.
+
+Replaces the reference's argparse constants + hardcoded paths
+(reference train.py:15-31, utils/train_utils.py:19-20, 26) with one dataclass.
+Field defaults mirror the reference CLI defaults (reference train.py:18-24).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    # -- strategy -----------------------------------------------------------
+    # one of: "singleGPU" (kept for CLI parity; means single-device),
+    # "DP", "DDP", "MP", "DDP_MP" (hybrid, new capability)
+    train_method: str = "singleGPU"
+
+    # -- optimization (reference train.py:18-24 defaults) -------------------
+    epochs: int = 10
+    learning_rate: float = 1e-4
+    batch_size: int = 4
+    val_percent: float = 10.0  # percent, divided by 100 like train_utils.py:35
+    seed: int = 42
+    weight_decay: float = 1e-8  # Adam L2, reference train_utils.py:45
+
+    # Reference quirk 1 (SURVEY.md §2): `(batch_size * loss).backward()` while
+    # recording the unscaled loss. Reproduced by default for curve parity.
+    faithful_loss_scaling: bool = True
+    # Reference quirk 2: DDP multiplies lr by world_size (train_utils.py:199).
+    ddp_lr_world_size_scaling: bool = True
+
+    # -- LR schedule: ReduceLROnPlateau(mode='min', patience=2) -------------
+    plateau_patience: int = 2
+    plateau_factor: float = 0.1
+
+    # -- data ---------------------------------------------------------------
+    data_dir: str = "./data"
+    images_subdir: str = "train_hq"
+    masks_subdir: str = "train_masks"
+    # (W, H) like the reference's `newsize=[960,640]` (train_utils.py:26);
+    # preprocess reads it as (newW, newH) (dataloading.py:29).
+    image_size: Tuple[int, int] = (960, 640)
+    num_workers: int = 0  # host-side prefetch threads (0 = synchronous)
+
+    # -- pipeline (MP) ------------------------------------------------------
+    num_microbatches: int = 2  # reference hardcodes 2 (unet_model.py:25)
+    num_stages: int = 2
+
+    # -- precision ----------------------------------------------------------
+    # bfloat16 keeps the MXU fed; params and loss stay float32.
+    compute_dtype: str = "bfloat16"
+
+    # -- artifacts (paths mirror the reference layout, §1 layer map) --------
+    checkpoint_dir: str = "./checkpoints"
+    log_dir: str = "./logs"
+    loss_dir: str = "./loss"
+    checkpoint_name: Optional[str] = None  # -c flag: load this checkpoint
+
+    # -- observability ------------------------------------------------------
+    metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)
+    profile_dir: Optional[str] = None  # jax.profiler trace capture when set
+
+    @property
+    def val_fraction(self) -> float:
+        return self.val_percent / 100.0
+
+    @property
+    def method_tag(self) -> str:
+        """Artifact directory tag, e.g. ./loss/<tag>/ and ./logs/<tag>.log."""
+        return self.train_method

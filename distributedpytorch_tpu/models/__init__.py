@@ -1,0 +1,1 @@
+from distributedpytorch_tpu.models.unet import UNet, ConvBlock, Encoder, Decoder  # noqa: F401

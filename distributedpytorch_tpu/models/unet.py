@@ -1,0 +1,166 @@
+"""UNet for binary segmentation, TPU-native (flax.linen, NHWC).
+
+Capability parity with the reference model (reference model/unet_parts.py:6-77,
+model/unet_model.py:4-62): a 4-down/4-up UNet with channel widths
+3→32→64→128→256, a 256→512 mid block, symmetric decoder with skip
+concatenation, a 1×1 segmentation head, and a sigmoid output. Parameter-count
+golden: 7,760,097 trainable parameters (reference model/modelsummary.txt:63).
+
+TPU-first divergences from the reference (deliberate, not bugs):
+  * NHWC layout throughout — XLA:TPU tiles the channel dimension onto the
+    (8,128)/(16,128) vector lanes; NCHW would force relayouts around every
+    conv. The data pipeline emits NHWC; a checkpoint shim handles NCHW
+    interop (see checkpoint.py).
+  * Convolutions are `flax.linen.Conv` → `lax.conv_general_dilated`; maxpool
+    is `lax.reduce_window`; the 2×2-stride-2 up-convolution is
+    `flax.linen.ConvTranspose` → `lax.conv_transpose`. All lower to MXU/VPU
+    ops — no Python-level loops.
+  * Compute dtype is configurable (default bfloat16 for the MXU); parameters
+    are float32.
+  * The center-crop of skip tensors (reference unet_parts.py:58-73 uses
+    torchvision CenterCrop) is a static slice; with 'SAME'-padded convs and
+    input sizes divisible by 16 it is a no-op, exactly as in the reference.
+
+The 2-stage pipeline split (reference unet_model.py:14-20: encoder+mid on
+stage 0, decoder+head on stage 1) is NOT baked into the model here — stage
+placement is a *strategy* concern handled in parallel/pipeline.py over the
+same flax modules (`UNet.encode_mid` / `UNet.decode_head`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# Channel plan of the reference model (unet_parts.py:28-33, 16, 51-54).
+ENCODER_WIDTHS = (32, 64, 128, 256)
+MID_WIDTH = 512
+
+
+def center_crop(x: jax.Array, target_hw: Tuple[int, int]) -> jax.Array:
+    """Static center crop of an NHWC tensor to (H, W) = target_hw.
+
+    Parity with torchvision CenterCrop as used at reference
+    unet_parts.py:58-73. Shapes are static under jit, so this is a slice,
+    not a dynamic gather.
+    """
+    h, w = x.shape[1], x.shape[2]
+    th, tw = target_hw
+    dh, dw = (h - th) // 2, (w - tw) // 2
+    return x[:, dh : dh + th, dw : dw + tw, :]
+
+
+class ConvBlock(nn.Module):
+    """[Conv3×3(pad=1) → ReLU] × 2 (reference unet_parts.py:6-17)."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv2")(x)
+        x = nn.relu(x)
+        return x
+
+
+def _maxpool2x2(x: jax.Array) -> jax.Array:
+    """MaxPool2d(kernel=2, stride=2) (reference unet_parts.py:26)."""
+    return nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+
+
+class Encoder(nn.Module):
+    """4 conv_blocks with 2×2 maxpool between; returns bottleneck + 4 skips
+    (reference unet_parts.py:19-41)."""
+
+    widths: Sequence[int] = ENCODER_WIDTHS
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        skips = []
+        for i, w in enumerate(self.widths):
+            x = ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}")(x)
+            skips.append(x)
+            x = _maxpool2x2(x)
+        return x, tuple(skips)
+
+
+class Decoder(nn.Module):
+    """4 × [ConvTranspose(k=2,s=2) → center-crop skip → concat → conv_block]
+    (reference unet_parts.py:43-77)."""
+
+    widths: Sequence[int] = tuple(reversed(ENCODER_WIDTHS))  # 256,128,64,32
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, skips: Sequence[jax.Array]) -> jax.Array:
+        # skips arrive encoder-ordered (shallow→deep); consume deepest first.
+        for i, (w, skip) in enumerate(zip(self.widths, reversed(skips))):
+            x = nn.ConvTranspose(
+                w, (2, 2), strides=(2, 2), dtype=self.dtype, name=f"upconv{i + 1}"
+            )(x)
+            skip = center_crop(skip, (x.shape[1], x.shape[2]))
+            x = jnp.concatenate([skip, x], axis=-1)
+            x = ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}")(x)
+        return x
+
+
+class UNet(nn.Module):
+    """Full UNet: Encoder → mid ConvBlock → Decoder → 1×1 head → sigmoid
+    (reference model/unet_model.py:4-11, forward at :55-61).
+
+    Input:  NHWC float, (B, H, W, 3), H and W divisible by 16.
+    Output: (B, H, W, 1) probabilities in (0, 1).
+    """
+
+    n_classes: int = 1
+    dtype: Any = jnp.bfloat16
+
+    def setup(self):
+        self.encoder = Encoder(dtype=self.dtype)
+        self.mid = ConvBlock(MID_WIDTH, dtype=self.dtype)
+        self.decoder = Decoder(dtype=self.dtype)
+        self.segmap = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype)
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x, skips = self.encode_mid(x)
+        return self.decode_head(x, skips)
+
+    # -- pipeline stage boundaries (reference unet_model.py:16-20 cut) -----
+    def encode_mid(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Stage 0 of the 2-stage pipeline: encoder + mid block."""
+        x, skips = self.encoder(x)
+        x = self.mid(x)
+        return x, skips
+
+    def decode_head(self, x: jax.Array, skips: Sequence[jax.Array]) -> jax.Array:
+        """Stage 1 of the 2-stage pipeline: decoder + 1×1 head + sigmoid.
+
+        The sigmoid runs in float32: probabilities feed a log-based loss and
+        bfloat16 resolution near 0/1 would poison it.
+        """
+        x = self.decoder(x, skips)
+        x = self.segmap(x)
+        return jax.nn.sigmoid(x.astype(jnp.float32))
+
+
+def create_unet(config=None, dtype=None) -> UNet:
+    """Build a UNet from a TrainConfig (or dtype override)."""
+    if dtype is None:
+        dtype = jnp.dtype(config.compute_dtype) if config is not None else jnp.bfloat16
+    return UNet(dtype=dtype)
+
+
+def init_unet_params(model: UNet, rng: jax.Array, input_hw=(640, 960)):
+    """Initialize parameters with a (1, H, W, 3) dummy batch."""
+    dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+    return model.init(rng, dummy)["params"]
+
+
+def param_count(params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

@@ -1,0 +1,2 @@
+from distributedpytorch_tpu.ops.losses import BCEDiceLoss, bce_dice_loss, dice_coefficient  # noqa: F401
+from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau  # noqa: F401

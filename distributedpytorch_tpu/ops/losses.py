@@ -1,0 +1,85 @@
+"""Segmentation loss: BCE − log(soft-Dice), plus a real Dice metric.
+
+Formula parity with the reference `Loss` (reference utils/utils.py:9-25):
+
+    loss = BCE(outputs, targets_bin)
+           - log( 2 * (outputs * targets_bin).sum()
+                  / (outputs.sum() + targets_bin.sum() + eps) )
+
+with ``eps = 1e-15`` and targets binarized by ``targets == 1``
+(utils.py:16). The BCE term reproduces torch.nn.BCELoss semantics: mean
+reduction and log terms clamped at -100 (torch clamps log(x) to >= -100 so a
+hard 0/1 prediction yields a finite loss).
+
+The reference never computes an actual Dice metric despite the segmentation
+task (SURVEY.md §2 quirk 6); `dice_coefficient` adds one — it is the
+"val Dice" used by the benchmark harness.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+EPS = 1e-15  # reference utils/utils.py:13
+_LOG_CLAMP = -100.0  # torch BCELoss log clamp
+
+
+def _clamped_log(x: jax.Array) -> jax.Array:
+    return jnp.maximum(jnp.log(x), _LOG_CLAMP)
+
+
+def binary_cross_entropy(outputs: jax.Array, targets: jax.Array) -> jax.Array:
+    """torch.nn.BCELoss() parity: mean over all elements, clamped logs."""
+    outputs = outputs.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    per_elem = -(
+        targets * _clamped_log(outputs) + (1.0 - targets) * _clamped_log(1.0 - outputs)
+    )
+    return jnp.mean(per_elem)
+
+
+def soft_dice(outputs: jax.Array, targets: jax.Array, eps: float = EPS) -> jax.Array:
+    """2·|o∩t| / (|o|+|t|+eps) over the whole batch (reference utils.py:18-23)."""
+    outputs = outputs.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    intersection = jnp.sum(outputs * targets)
+    union = jnp.sum(outputs) + jnp.sum(targets)
+    return 2.0 * intersection / (union + eps)
+
+
+def bce_dice_loss(
+    outputs: jax.Array, targets: jax.Array, dice_weight: float = 1.0
+) -> jax.Array:
+    """BCE − dice_weight · log(soft dice), target binarized by ``== 1``.
+
+    `outputs` are probabilities (post-sigmoid) shaped like `targets`
+    broadcast-compatibly; both are flattened by the reductions.
+    """
+    targets_bin = (targets == 1).astype(jnp.float32)  # reference utils.py:16
+    bce = binary_cross_entropy(outputs, targets_bin)
+    dice = soft_dice(outputs, targets_bin)
+    return bce - dice_weight * _clamped_log(dice)
+
+
+class BCEDiceLoss:
+    """Callable wrapper mirroring the reference `Loss(dice_weight=1)` object
+    (reference utils/utils.py:9-12)."""
+
+    def __init__(self, dice_weight: float = 1.0):
+        self.dice_weight = dice_weight
+
+    def __call__(self, outputs: jax.Array, targets: jax.Array) -> jax.Array:
+        return bce_dice_loss(outputs, targets, self.dice_weight)
+
+
+def dice_coefficient(
+    outputs: jax.Array, targets: jax.Array, threshold: float = 0.5, eps: float = 1e-7
+) -> jax.Array:
+    """Hard Dice on thresholded predictions — the real segmentation metric the
+    reference lacks (SURVEY.md §2 quirk 6). Used for val-Dice benchmarking."""
+    preds = (outputs.astype(jnp.float32) >= threshold).astype(jnp.float32)
+    targets_bin = (targets == 1).astype(jnp.float32)
+    intersection = jnp.sum(preds * targets_bin)
+    union = jnp.sum(preds) + jnp.sum(targets_bin)
+    return (2.0 * intersection + eps) / (union + eps)

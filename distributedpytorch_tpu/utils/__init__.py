@@ -1,0 +1,1 @@
+from distributedpytorch_tpu.utils.seeding import set_seed  # noqa: F401

@@ -1,0 +1,24 @@
+"""Determinism helpers.
+
+Parity with the reference `set_seed` (reference utils/utils.py:28-35), which
+seeds python/numpy/torch and sets PYTHONHASHSEED + cuDNN toggles. On TPU, XLA
+compilation is deterministic by default, and JAX randomness is explicit
+(`jax.random.key`), so this shrinks to seeding the host-side RNGs (data
+shuffling, splits) and exporting PYTHONHASHSEED.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+
+import jax
+import numpy as np
+
+
+def set_seed(seed: int) -> jax.Array:
+    """Seed host RNGs; returns the root `jax.random` key for device RNG."""
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ["PYTHONHASHSEED"] = str(seed)
+    return jax.random.key(seed)

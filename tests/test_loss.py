@@ -1,0 +1,83 @@
+"""Golden tests for BCE − log-dice loss vs the reference formula
+(reference utils/utils.py:9-25), cross-checked against torch (CPU)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.ops.losses import (
+    BCEDiceLoss,
+    bce_dice_loss,
+    binary_cross_entropy,
+    dice_coefficient,
+    soft_dice,
+)
+
+torch = pytest.importorskip("torch")
+
+
+def _reference_loss(outputs, targets, dice_weight=1.0, eps=1e-15):
+    """Literal re-statement of the reference formula using torch ops."""
+    o = torch.tensor(np.asarray(outputs), dtype=torch.float32)
+    t = torch.tensor(np.asarray(targets), dtype=torch.float32)
+    nll = torch.nn.BCELoss()(o, (t == 1).float())
+    tb = (t == 1).float()
+    intersection = (o * tb).sum()
+    union = o.sum() + tb.sum()
+    return float(nll - dice_weight * torch.log(2 * intersection / (union + eps)))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_loss_matches_reference_formula(seed):
+    rng = np.random.default_rng(seed)
+    outputs = rng.uniform(1e-4, 1 - 1e-4, size=(2, 8, 8, 1)).astype(np.float32)
+    targets = rng.integers(0, 2, size=(2, 8, 8, 1)).astype(np.float32)
+    ours = float(bce_dice_loss(jnp.asarray(outputs), jnp.asarray(targets)))
+    ref = _reference_loss(outputs, targets)
+    assert abs(ours - ref) < 1e-5
+
+
+def test_binarization_by_equality_with_one():
+    """Targets are binarized by `== 1` (utils.py:16): a 255-valued mask
+    contributes an all-zero dice target — quirk documented in SURVEY.md §2.3."""
+    outputs = jnp.full((1, 4, 4, 1), 0.9)
+    targets_255 = jnp.full((1, 4, 4, 1), 255.0)
+    targets_1 = jnp.ones((1, 4, 4, 1))
+    assert float(soft_dice(outputs, (targets_255 == 1).astype(jnp.float32))) == 0.0
+    assert float(bce_dice_loss(outputs, targets_1)) < float(
+        bce_dice_loss(outputs, targets_255)
+    )
+
+
+def test_bce_log_clamp_finite_at_extremes():
+    """torch BCELoss clamps log at -100 → hard 0/1 predictions stay finite."""
+    outputs = jnp.array([[0.0, 1.0]])
+    targets = jnp.array([[1.0, 0.0]])
+    val = float(binary_cross_entropy(outputs, targets))
+    assert np.isfinite(val)
+    assert val == pytest.approx(100.0)
+
+
+def test_perfect_prediction_loss_near_zero():
+    targets = jnp.array([[1.0, 0.0, 1.0, 1.0]])
+    outputs = jnp.array([[1.0 - 1e-7, 1e-7, 1.0 - 1e-7, 1.0 - 1e-7]])
+    assert float(bce_dice_loss(outputs, targets)) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_loss_callable_wrapper():
+    loss = BCEDiceLoss(dice_weight=0.5)
+    outputs = jnp.full((1, 4), 0.7)
+    targets = jnp.ones((1, 4))
+    expected = binary_cross_entropy(outputs, targets) - 0.5 * jnp.log(
+        soft_dice(outputs, targets)
+    )
+    assert float(loss(outputs, targets)) == pytest.approx(float(expected), abs=1e-6)
+
+
+def test_dice_coefficient_metric():
+    outputs = jnp.array([[0.9, 0.8, 0.1, 0.2]])
+    targets = jnp.array([[1.0, 1.0, 0.0, 0.0]])
+    assert float(dice_coefficient(outputs, targets)) == pytest.approx(1.0, abs=1e-5)
+    assert float(
+        dice_coefficient(outputs, jnp.array([[0.0, 0.0, 1.0, 1.0]]))
+    ) == pytest.approx(0.0, abs=1e-5)

@@ -1,0 +1,92 @@
+"""Golden tests for the UNet model (SURVEY.md §4 implication list)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributedpytorch_tpu.models.unet import (
+    UNet,
+    center_crop,
+    init_unet_params,
+    param_count,
+)
+
+REFERENCE_PARAM_COUNT = 7_760_097  # reference model/modelsummary.txt:63
+
+
+@pytest.fixture(scope="module")
+def small_unet():
+    model = UNet(dtype=jnp.float32)
+    params = init_unet_params(model, jax.random.key(0), input_hw=(64, 96))
+    return model, params
+
+
+def test_param_count_matches_reference(small_unet):
+    _, params = small_unet
+    assert param_count(params) == REFERENCE_PARAM_COUNT
+
+
+def test_output_shape_and_range(small_unet):
+    model, params = small_unet
+    x = jax.random.uniform(jax.random.key(1), (2, 64, 96, 3))
+    y = model.apply({"params": params}, x)
+    assert y.shape == (2, 64, 96, 1)
+    assert y.dtype == jnp.float32  # sigmoid head promotes to f32
+    assert bool(jnp.all(y > 0)) and bool(jnp.all(y < 1))
+
+
+def test_full_resolution_shape():
+    # The reference self-test shape: (1, 3, 640, 960) NCHW → ours NHWC
+    # (reference model/unet_model.py:64-67). Eval-shape only to stay fast.
+    model = UNet(dtype=jnp.float32)
+    x = jnp.zeros((1, 640, 960, 3))
+    shapes = jax.eval_shape(
+        lambda: model.init_with_output(jax.random.key(0), x)[0]
+    )
+    assert shapes.shape == (1, 640, 960, 1)
+
+
+def test_stage_split_equals_full_forward(small_unet):
+    """encode_mid ∘ decode_head == __call__ — the pipeline cut is lossless
+    (reference cut at model/unet_model.py:16-20)."""
+    model, params = small_unet
+    x = jax.random.uniform(jax.random.key(2), (1, 64, 96, 3))
+    full = model.apply({"params": params}, x)
+    mid, skips = model.apply({"params": params}, x, method=UNet.encode_mid)
+    staged = model.apply({"params": params}, mid, skips, method=UNet.decode_head)
+    assert jnp.allclose(full, staged)
+
+
+def test_encoder_skip_shapes(small_unet):
+    model, params = small_unet
+    x = jnp.zeros((1, 64, 96, 3))
+    mid, skips = model.apply({"params": params}, x, method=UNet.encode_mid)
+    assert [s.shape for s in skips] == [
+        (1, 64, 96, 32),
+        (1, 32, 48, 64),
+        (1, 16, 24, 128),
+        (1, 8, 12, 256),
+    ]
+    assert mid.shape == (1, 4, 6, 512)
+
+
+def test_center_crop():
+    x = jnp.arange(5 * 6).reshape(1, 5, 6, 1).astype(jnp.float32)
+    y = center_crop(x, (3, 4))
+    assert y.shape == (1, 3, 4, 1)
+    assert float(y[0, 0, 0, 0]) == float(x[0, 1, 1, 0])
+
+
+def test_gradients_flow(small_unet):
+    model, params = small_unet
+    x = jax.random.uniform(jax.random.key(3), (1, 32, 32, 3))
+    t = (jax.random.uniform(jax.random.key(4), (1, 32, 32, 1)) > 0.5).astype(jnp.float32)
+
+    def loss_fn(p):
+        y = model.apply({"params": p}, x)
+        return jnp.mean((y - t) ** 2)
+
+    grads = jax.grad(loss_fn)(params)
+    norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
+    assert all(n == n for n in norms)  # no NaNs
+    assert sum(norms) > 0

@@ -1,0 +1,47 @@
+"""ReduceLROnPlateau parity tests vs torch.optim.lr_scheduler."""
+
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
+
+torch = pytest.importorskip("torch")
+
+
+def _torch_plateau_lrs(metrics, lr=1e-4, patience=2, factor=0.1):
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.Adam([p], lr=lr)
+    sched = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        opt, "min", patience=patience, factor=factor
+    )
+    lrs = []
+    for m in metrics:
+        sched.step(m)
+        lrs.append(opt.param_groups[0]["lr"])
+    return lrs
+
+
+@pytest.mark.parametrize(
+    "metrics",
+    [
+        [1.0, 0.9, 0.8, 0.7, 0.6],  # monotone improvement: no reduction
+        [1.0, 1.0, 1.0, 1.0, 1.0, 1.0],  # plateau: reduce after patience
+        [1.0, 0.5, 0.6, 0.7, 0.8, 0.4, 0.9, 0.9, 0.9, 0.9],  # mixed
+        list(np.random.default_rng(0).uniform(0.1, 1.0, size=20)),
+    ],
+)
+def test_matches_torch(metrics):
+    ours = ReduceLROnPlateau(lr=1e-4, patience=2, factor=0.1)
+    got = [ours.step(m) for m in metrics]
+    want = _torch_plateau_lrs(metrics)
+    assert got == pytest.approx(want, rel=1e-9)
+
+
+def test_state_roundtrip():
+    s = ReduceLROnPlateau(lr=1e-3)
+    s.step(1.0)
+    s.step(1.0)
+    state = s.state_dict()
+    s2 = ReduceLROnPlateau(lr=999.0)
+    s2.load_state_dict(state)
+    assert s2.lr == s.lr and s2.best == s.best and s2.num_bad_epochs == s.num_bad_epochs
