@@ -1,0 +1,80 @@
+#!/usr/bin/env python3
+"""Benchmark harness: UNet training throughput on the available hardware.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}
+
+Measured config = the reference's measured config (reference train.py:18-24:
+batch 4, 3×640×960, Adam 1e-4, BCE−log-dice), single chip, bf16 compute.
+
+``vs_baseline``: the reference publishes no throughput numbers (SURVEY.md
+§6); BASELINE.md's operational target is the 2×GPU DDP config. Until a
+measured GPU number exists we normalize against an estimated 2×RTX-3090-class
+DDP throughput for this exact model/shape (≈17 imgs/sec: ~7.3 TFLOP/img
+forward+backward at ~30% utilization per GPU, README-era hardware), recorded
+here so the denominator is explicit and revisable.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Estimated reference DDP (2 GPU) throughput for batch 4 @ 3x640x960 —
+# see module docstring; revise when a measured number lands in BASELINE.md.
+BASELINE_IMGS_PER_SEC = 17.0
+
+BATCH = 4
+H, W = 640, 960
+WARMUP_STEPS = 3
+MEASURE_STEPS = 20
+
+
+def main():
+    from distributedpytorch_tpu.models.unet import UNet, init_unet_params
+    from distributedpytorch_tpu.train.steps import create_train_state, make_train_step
+
+    model = UNet(dtype=jnp.bfloat16)
+    params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
+    state, tx = create_train_state(params, 1e-4)
+    step = jax.jit(make_train_step(model, tx, batch_size=BATCH), donate_argnums=(0,))
+
+    rng = np.random.default_rng(0)
+    dev = jax.devices()[0]
+    batch = {
+        "image": jax.device_put(rng.random((BATCH, H, W, 3), dtype=np.float32), dev),
+        "mask": jax.device_put(
+            (rng.random((BATCH, H, W)) > 0.5).astype(np.int32), dev
+        ),
+    }
+    state = jax.device_put(state, dev)
+
+    for _ in range(WARMUP_STEPS):
+        state, loss = step(state, batch)
+    float(loss)  # device→host transfer: a hard sync even over a PJRT relay
+    # (block_until_ready alone does not force execution on tunneled devices)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, loss = step(state, batch)
+    float(loss)  # forces the whole dependency chain of donated states
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = MEASURE_STEPS * BATCH / dt
+    platform = dev.platform
+    print(
+        json.dumps(
+            {
+                "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{platform}",
+                "value": round(imgs_per_sec, 2),
+                "unit": "imgs/sec",
+                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

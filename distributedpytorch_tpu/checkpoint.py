@@ -1,0 +1,198 @@
+"""Checkpointing: native full-state save/resume + reference .pth interop.
+
+The reference saves a bare ``state_dict`` once, after the final epoch, and
+can only reload weights — no optimizer/scheduler/step state, so no true
+resume (reference utils/train_utils.py:88, train.py:42-43; SURVEY.md §5).
+This module fixes that:
+
+  * `save_checkpoint` / `load_checkpoint` — the native format: one msgpack
+    file holding params, Adam state, plateau-scheduler state, step and epoch
+    counters. Written atomically (tmp + rename) so a crash mid-write never
+    corrupts the previous checkpoint. Device arrays are gathered to host
+    numpy first, so a sharded (DDP / pipeline) run saves exactly once per
+    process-0 without layout baggage — restored params can be re-placed
+    under any strategy's sharding.
+  * `export_reference_pth` / `import_reference_pth` — interop shim keyed to
+    the reference's parameter names (``encoder.conv1.conv_block.0.weight``…,
+    reference model/unet_parts.py:9-14, 22-26, 46-54, unet_model.py:7-10)
+    with NHWC↔NCHW kernel transposes. Import tolerates the DDP ``module.``
+    key prefix the reference leaks into its DDP checkpoints (quirk 9).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import flax.serialization
+import jax
+import numpy as np
+
+CKPT_VERSION = 1
+
+
+def _to_host(tree):
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_checkpoint(
+    path: str,
+    params,
+    opt_state=None,
+    scheduler_state: Optional[dict] = None,
+    step: int = 0,
+    epoch: int = 0,
+) -> None:
+    payload = {
+        "version": CKPT_VERSION,
+        "params": flax.serialization.to_state_dict(_to_host(params)),
+        "opt_state": flax.serialization.to_state_dict(_to_host(opt_state))
+        if opt_state is not None
+        else None,
+        "scheduler": scheduler_state,
+        "step": int(step),
+        "epoch": int(epoch),
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    blob = flax.serialization.msgpack_serialize(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+
+
+def load_checkpoint(
+    path: str, params_target, opt_state_target=None
+) -> Dict[str, Any]:
+    """Restore a checkpoint into the given target structures.
+
+    Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch'}``;
+    `opt_state` is None when the checkpoint predates it or no target given.
+    """
+    with open(path, "rb") as f:
+        payload = flax.serialization.msgpack_restore(f.read())
+    out = {
+        "params": flax.serialization.from_state_dict(params_target, payload["params"]),
+        "opt_state": None,
+        "scheduler": payload.get("scheduler"),
+        "step": int(payload.get("step", 0)),
+        "epoch": int(payload.get("epoch", 0)),
+    }
+    if payload.get("opt_state") is not None and opt_state_target is not None:
+        out["opt_state"] = flax.serialization.from_state_dict(
+            opt_state_target, payload["opt_state"]
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Reference .pth interop
+# ---------------------------------------------------------------------------
+
+# (flax module path) -> (reference state_dict stem). conv1/conv2 inside a
+# ConvBlock map to Sequential indices 0/2 (reference unet_parts.py:9-14).
+_BLOCK_MAPS: Tuple[Tuple[Tuple[str, ...], str], ...] = tuple(
+    [(("encoder", f"block{i}"), f"encoder.conv{i}") for i in range(1, 5)]
+    + [(("mid",), "mid")]
+    + [(("decoder", f"block{i}"), f"decoder.conv{i}") for i in range(1, 5)]
+)
+
+
+def _flatten_params(params) -> Dict[Tuple[str, ...], np.ndarray]:
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(prefix + (k,), v)
+        else:
+            flat[prefix] = np.asarray(jax.device_get(node))
+
+    walk((), flax.serialization.to_state_dict(params))
+    return flat
+
+
+def _name_map() -> Dict[Tuple[str, ...], str]:
+    """flax param path → reference tensor name."""
+    m: Dict[Tuple[str, ...], str] = {}
+    for flax_path, ref_stem in _BLOCK_MAPS:
+        for conv, seq_idx in (("conv1", 0), ("conv2", 2)):
+            m[flax_path + (conv, "kernel")] = f"{ref_stem}.conv_block.{seq_idx}.weight"
+            m[flax_path + (conv, "bias")] = f"{ref_stem}.conv_block.{seq_idx}.bias"
+    for i in range(1, 5):
+        m[("decoder", f"upconv{i}", "kernel")] = f"decoder.deconv{i}.weight"
+        m[("decoder", f"upconv{i}", "bias")] = f"decoder.deconv{i}.bias"
+    m[("segmap", "kernel")] = "segmap.weight"
+    m[("segmap", "bias")] = "segmap.bias"
+    return m
+
+
+def export_reference_state_dict(params) -> Dict[str, np.ndarray]:
+    """flax params (NHWC kernels) → reference-named dict (NCHW layouts).
+
+    Conv kernels (kh, kw, I, O) → torch (O, I, kh, kw); ConvTranspose
+    kernels (kh, kw, I, O) → torch (I, O, kh, kw) with a spatial flip —
+    lax.conv_transpose correlates with the mirrored kernel relative to
+    torch's scatter semantics (validated in tests/test_checkpoint.py).
+    """
+    flat = _flatten_params(params)
+    names = _name_map()
+    out: Dict[str, np.ndarray] = {}
+    for path, arr in flat.items():
+        name = names[path]
+        if path[-1] == "kernel":
+            if "upconv" in path[-2]:
+                arr = arr[::-1, ::-1].transpose(2, 3, 0, 1)  # → (I, O, kh, kw)
+            else:
+                arr = arr.transpose(3, 2, 0, 1)  # → (O, I, kh, kw)
+        out[name] = np.ascontiguousarray(arr)
+    return out
+
+
+def import_reference_state_dict(
+    state_dict: Dict[str, np.ndarray], params_target
+):
+    """Reference-named (possibly ``module.``-prefixed, quirk 9) dict → flax
+    params shaped like `params_target`."""
+    cleaned = {
+        (k[len("module.") :] if k.startswith("module.") else k): np.asarray(v)
+        for k, v in state_dict.items()
+    }
+    names = _name_map()
+    target_flat = _flatten_params(params_target)
+    new_flat: Dict[Tuple[str, ...], np.ndarray] = {}
+    for path in target_flat:
+        arr = cleaned[names[path]]
+        if path[-1] == "kernel":
+            if "upconv" in path[-2]:
+                arr = arr.transpose(2, 3, 0, 1)[::-1, ::-1]  # (I,O,kh,kw) → flax
+            else:
+                arr = arr.transpose(2, 3, 1, 0)  # (O,I,kh,kw) → (kh,kw,I,O)
+        new_flat[path] = np.ascontiguousarray(arr)
+
+    def rebuild(prefix, node):
+        if isinstance(node, dict):
+            return {k: rebuild(prefix + (k,), v) for k, v in node.items()}
+        return new_flat[prefix]
+
+    as_dict = rebuild((), flax.serialization.to_state_dict(params_target))
+    return flax.serialization.from_state_dict(params_target, as_dict)
+
+
+def export_reference_pth(params, path: str) -> None:
+    """Write a real torch ``.pth`` loadable by the reference's
+    ``model.load_state_dict(torch.load(...))`` (reference train.py:43)."""
+    import torch
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    sd = {k: torch.from_numpy(v.copy()) for k, v in export_reference_state_dict(params).items()}
+    torch.save(sd, path)
+
+
+def import_reference_pth(path: str, params_target):
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return import_reference_state_dict(
+        {k: v.numpy() for k, v in sd.items()}, params_target
+    )

@@ -58,6 +58,12 @@ class TrainConfig:
     log_dir: str = "./logs"
     loss_dir: str = "./loss"
     checkpoint_name: Optional[str] = None  # -c flag: load this checkpoint
+    # Mid-run checkpointing (crash recovery the reference lacks, SURVEY.md
+    # §5 'Failure detection'): save every N epochs; 0 = final save only.
+    checkpoint_every_epochs: int = 1
+
+    # -- synthetic data (tests / benches without the Carvana download) ------
+    synthetic_samples: int = 0  # >0: use an in-memory procedural dataset
 
     # -- observability ------------------------------------------------------
     metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)

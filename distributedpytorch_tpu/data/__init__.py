@@ -1,0 +1,21 @@
+"""Data subsystem: filesystem-paired segmentation datasets + sharded loading.
+
+TPU-native replacement for the reference's torch `Dataset`/`DataLoader`/
+`DistributedSampler` stack (reference utils/dataloading.py, train_utils.py
+:40-42, :189-191): numpy-producing datasets, a deterministic seeded split, a
+per-process sharding sampler with working per-epoch reshuffle, threaded
+host-side prefetch, and NHWC batches ready for `jax.device_put`.
+"""
+
+from distributedpytorch_tpu.data.dataset import (  # noqa: F401
+    BasicDataset,
+    CarvanaDataset,
+    SyntheticSegmentationDataset,
+    build_dataset,
+    write_synthetic_carvana_tree,
+)
+from distributedpytorch_tpu.data.loader import (  # noqa: F401
+    DataLoader,
+    ShardSpec,
+    seeded_split,
+)

@@ -1,0 +1,169 @@
+"""Deterministic split + sharded, prefetching batch loader.
+
+Replaces the reference's `random_split` + `DataLoader` + `DistributedSampler`
+stack (reference utils/train_utils.py:35-42, :185-191) with host-side numpy
+machinery sized for a JAX trainer:
+
+  * `seeded_split` — ONE deterministic split shared by every strategy and
+    every process. This deliberately fixes reference quirk 5 (SURVEY.md §2):
+    the reference's DDP path splits with a differently-seeded generator than
+    its single/DP paths, so val curves were never comparable across methods.
+  * `ShardSpec` — DistributedSampler-equivalent per-process sharding: pad the
+    sample list to a multiple of world size by wrapping around (exactly what
+    torch's DistributedSampler does), then stride by rank.
+  * `DataLoader` — per-epoch reshuffle driven by (seed, epoch); the epoch is
+    an argument to `epoch_batches`, which structurally fixes the reference's
+    missing `sampler.set_epoch` (SURVEY.md §3.2) — you cannot forget to pass
+    it. Decodes items with a thread pool (the torch `num_workers=1` process
+    boundary, train_utils.py:40, becomes threads: PIL decode releases the
+    GIL) and assembles NHWC batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+def seeded_split(
+    n: int, val_fraction: float, seed: int = 0
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic (train_indices, val_indices) split.
+
+    `n_val = int(n * val_fraction)` matches the reference's
+    ``int(len(dataset) * val_percent/100)`` rounding (train_utils.py:35-36).
+    """
+    n_val = int(n * val_fraction)
+    perm = np.random.default_rng(seed).permutation(n)
+    return perm[n_val:], perm[:n_val]
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardSpec:
+    """Which contiguous-strided shard of each (padded) epoch this process owns.
+
+    rank/world mirror `DistributedSampler(dataset, num_replicas, rank)`
+    (reference train_utils.py:189): pad by wrap-around so every rank sees the
+    same number of samples, then take indices[rank::world].
+    """
+
+    rank: int = 0
+    world: int = 1
+
+    def __post_init__(self):
+        if not 0 <= self.rank < self.world:
+            raise ValueError(f"rank {self.rank} out of range for world {self.world}")
+
+    def shard(self, order: np.ndarray) -> np.ndarray:
+        if self.world == 1:
+            return order
+        total = -(-len(order) // self.world) * self.world  # ceil to multiple
+        # repeat the whole list as many times as needed (order can be shorter
+        # than the padding when world > len(order)), then truncate — torch
+        # DistributedSampler semantics: every rank gets exactly total/world
+        reps = -(-total // len(order))
+        padded = np.concatenate([order] * reps)[:total]
+        return padded[self.rank :: self.world]
+
+
+class DataLoader:
+    """Batched, optionally sharded, thread-prefetched iterator over a dataset.
+
+    `dataset` is anything with `__len__` and `__getitem__` returning
+    ``{'image': (H,W,C) f32, 'mask': (H,W) i32}`` (see data/dataset.py).
+    """
+
+    def __init__(
+        self,
+        dataset,
+        indices: Optional[Sequence[int]] = None,
+        batch_size: int = 4,
+        shuffle: bool = False,
+        drop_last: bool = False,
+        seed: int = 0,
+        shard: ShardSpec = ShardSpec(),
+        num_workers: int = 0,
+    ):
+        self.dataset = dataset
+        self.indices = (
+            np.arange(len(dataset)) if indices is None else np.asarray(indices)
+        )
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.shard_spec = shard
+        self.num_workers = int(num_workers)
+        self._pool = (
+            ThreadPoolExecutor(max_workers=self.num_workers)
+            if self.num_workers > 0
+            else None
+        )
+
+    def __len__(self) -> int:
+        """Batches per epoch for this shard."""
+        n = len(self.shard_spec.shard(self.indices))
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def steps_per_epoch(self) -> int:
+        return len(self)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        order = self.indices
+        if self.shuffle:
+            # (seed, epoch)-keyed reshuffle — identical on every process, so
+            # shards stay disjoint; varies per epoch, fixing the reference's
+            # missing set_epoch (SURVEY.md §3.2).
+            rng = np.random.default_rng((self.seed, epoch))
+            order = rng.permutation(order)
+        return self.shard_spec.shard(order)
+
+    def _assemble(self, items: List[dict]) -> Batch:
+        return {
+            "image": np.stack([it["image"] for it in items]),
+            "mask": np.stack([it["mask"] for it in items]),
+        }
+
+    def epoch_batches(self, epoch: int = 0) -> Iterator[Batch]:
+        order = self._epoch_order(epoch)
+        cut = (
+            len(order) - len(order) % self.batch_size
+            if self.drop_last
+            else len(order)
+        )
+        order = order[:cut]
+        starts = range(0, len(order), self.batch_size)
+        if self._pool is None:
+            for s in starts:
+                yield self._assemble(
+                    [self.dataset[int(i)] for i in order[s : s + self.batch_size]]
+                )
+            return
+
+        # Threaded prefetch: keep up to 2 batches of item-futures in flight.
+        def submit(s):
+            return [
+                self._pool.submit(self.dataset.__getitem__, int(i))
+                for i in order[s : s + self.batch_size]
+            ]
+
+        pending: List = []
+        starts = list(starts)
+        depth = 2
+        for s in starts[:depth]:
+            pending.append(submit(s))
+        next_submit = depth
+        while pending:
+            futures = pending.pop(0)
+            if next_submit < len(starts):
+                pending.append(submit(starts[next_submit]))
+                next_submit += 1
+            yield self._assemble([f.result() for f in futures])
+
+    def __iter__(self) -> Iterator[Batch]:
+        return self.epoch_batches(0)

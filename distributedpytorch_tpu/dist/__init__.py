@@ -1,0 +1,7 @@
+"""Multi-process runtime glue (the reference's torch.distributed layer)."""
+
+from distributedpytorch_tpu.dist.runtime import (  # noqa: F401
+    RuntimeInfo,
+    initialize_from_env,
+    shutdown,
+)

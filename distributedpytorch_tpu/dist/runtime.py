@@ -1,0 +1,96 @@
+"""Process-group runtime: `jax.distributed` with torchrun-compatible env.
+
+The reference joins its process group with
+``dist.init_process_group('nccl', init_method='env://')`` under a torchrun
+launcher that sets LOCAL_RANK / RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT
+(reference train.py:29-31, :58-61; README.md:37). The TPU-native equivalent
+is `jax.distributed.initialize`, which on real TPU pods autodetects topology;
+off-pod (or when launched by torchrun per the driver's north star) we map the
+torchrun env onto its coordinator/process arguments.
+
+No NCCL anywhere: after initialization, collectives are XLA's, riding ICI
+within a pod slice and DCN across slices (SURVEY.md §5 'Distributed
+communication backend').
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+_INITIALIZED = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeInfo:
+    process_id: int
+    num_processes: int
+    coordinator: Optional[str]
+
+    @property
+    def is_main(self) -> bool:
+        return self.process_id == 0
+
+
+def _torchrun_env() -> Optional[RuntimeInfo]:
+    """Map torchrun's env contract onto jax.distributed's, if present."""
+    if "WORLD_SIZE" not in os.environ or "RANK" not in os.environ:
+        return None
+    world = int(os.environ["WORLD_SIZE"])
+    rank = int(os.environ["RANK"])
+    addr = os.environ.get("MASTER_ADDR", "127.0.0.1")
+    # jax.distributed's coordinator must not collide with torchrun's c10d
+    # rendezvous port, so offset it deterministically.
+    port = int(os.environ.get("MASTER_PORT", "29500")) + 1
+    return RuntimeInfo(rank, world, f"{addr}:{port}")
+
+
+def initialize_from_env(force: bool = False) -> RuntimeInfo:
+    """Initialize multi-process JAX if a launcher env is present.
+
+    Order: explicit JAX_COORDINATOR env → torchrun env → single process.
+    Safe to call unconditionally (idempotent; no-op single-process)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        return RuntimeInfo(jax.process_index(), jax.process_count(), None)
+
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coord:
+        info = RuntimeInfo(
+            int(os.environ.get("JAX_PROCESS_ID", "0")),
+            int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+            coord,
+        )
+    else:
+        info = _torchrun_env()
+
+    if info is None or info.num_processes <= 1:
+        return RuntimeInfo(0, 1, None)
+
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    _INITIALIZED = True
+    logger.info(
+        "jax.distributed initialized: process %d/%d via %s",
+        info.process_id,
+        info.num_processes,
+        info.coordinator,
+    )
+    return info
+
+
+def shutdown() -> None:
+    """`dist.destroy_process_group` parity (reference train.py:61)."""
+    global _INITIALIZED
+    if _INITIALIZED:
+        jax.distributed.shutdown()
+        _INITIALIZED = False

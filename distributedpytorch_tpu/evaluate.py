@@ -1,0 +1,38 @@
+"""Validation pass: mean per-batch loss (+ the Dice metric the reference
+never computes).
+
+Parity with reference evaluate.py:6-25 — eval-mode forward over the val
+loader, mean of per-batch criterion values. The UNet has no dropout/batchnorm
+so train/eval mode is a no-op distinction (the reference toggles it anyway);
+here the same pure apply serves both.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+
+def evaluate(
+    eval_step: Callable,
+    params,
+    loader,
+    place_batch: Callable = None,
+    epoch: int = 0,
+) -> Tuple[float, float]:
+    """Returns (mean val loss, mean val dice) over the loader.
+
+    `eval_step(params, batch) -> {'loss', 'dice'}` is the strategy-jitted
+    step; `place_batch` moves host batches onto the mesh.
+    """
+    losses, dices = [], []
+    for batch in loader.epoch_batches(epoch):
+        if place_batch is not None:
+            batch = place_batch(batch)
+        metrics = eval_step(params, batch)
+        losses.append(float(metrics["loss"]))
+        dices.append(float(metrics["dice"]))
+    if not losses:
+        return float("nan"), float("nan")
+    return float(np.mean(losses)), float(np.mean(dices))

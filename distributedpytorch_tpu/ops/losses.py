@@ -62,6 +62,41 @@ def bce_dice_loss(
     return bce - dice_weight * _clamped_log(dice)
 
 
+def bce_dice_stats(outputs: jax.Array, targets: jax.Array) -> jax.Array:
+    """Sufficient statistics of the BCE−log-dice loss over a slice of the
+    batch: ``[bce_sum, count, intersection, output_sum + target_sum]``.
+
+    The log-dice term is a ratio of whole-batch sums, so a microbatched
+    pipeline cannot average per-microbatch losses (mean of log-dice ≠
+    log-dice of the mean) — it must accumulate these stats and call
+    `loss_from_stats` once. Stats are additive: sum over microbatches /
+    shards / stages (a psum) THEN combine, and the result is bit-comparable
+    to the single-pass loss on the concatenated batch.
+    """
+    outputs = outputs.astype(jnp.float32)
+    targets_bin = (targets == 1).astype(jnp.float32)
+    per_elem = -(
+        targets_bin * _clamped_log(outputs)
+        + (1.0 - targets_bin) * _clamped_log(1.0 - outputs)
+    )
+    return jnp.stack(
+        [
+            jnp.sum(per_elem),
+            jnp.asarray(outputs.size, jnp.float32),
+            jnp.sum(outputs * targets_bin),
+            jnp.sum(outputs) + jnp.sum(targets_bin),
+        ]
+    )
+
+
+def loss_from_stats(stats: jax.Array, dice_weight: float = 1.0, eps: float = EPS) -> jax.Array:
+    """Combine accumulated `bce_dice_stats` into the scalar loss."""
+    bce_sum, count, intersection, union = stats[0], stats[1], stats[2], stats[3]
+    bce = bce_sum / count
+    dice = 2.0 * intersection / (union + eps)
+    return bce - dice_weight * _clamped_log(dice)
+
+
 class BCEDiceLoss:
     """Callable wrapper mirroring the reference `Loss(dice_weight=1)` object
     (reference utils/utils.py:9-12)."""

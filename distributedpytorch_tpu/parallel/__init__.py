@@ -1,0 +1,23 @@
+"""Parallelism strategies over a `jax.sharding.Mesh`.
+
+Capability parity with the reference's four execution modes plus the hybrid
+(SURVEY.md §2 checklist): single device, DP (single-process data parallel,
+reference train_utils.py:98), DDP (multi-process data parallel with gradient
+all-reduce, train_utils.py:170-248), MP (2-stage microbatched pipeline,
+unet_model.py:14-53), and DDP×MP on a 2-D ('data', 'stage') mesh — expressed
+as mesh + shardings + collectives, not NCCL/CUDA streams.
+"""
+
+from distributedpytorch_tpu.parallel.strategy import (  # noqa: F401
+    STRATEGIES,
+    DataParallel,
+    DistributedDataParallel,
+    HybridDataPipeline,
+    Pipeline,
+    SingleDevice,
+    Strategy,
+    build_strategy,
+)
+from distributedpytorch_tpu.parallel.pipeline import (  # noqa: F401
+    make_pipeline_loss_fn,
+)

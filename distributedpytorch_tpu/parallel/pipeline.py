@@ -1,0 +1,228 @@
+"""GPipe-style microbatched pipeline over a 'stage' mesh axis.
+
+TPU-native re-design of the reference's hand-written 2-GPU pipeline
+(reference model/unet_model.py:14-53). The reference gets overlap for free
+from async CUDA launches: while cuda:1 decodes microbatch i, cuda:0 encodes
+microbatch i+1, with the bottleneck + all 4 skip tensors copied cuda:0→cuda:1
+each microbatch (unet_model.py:36-37,47-48). On TPU the same schedule is
+written explicitly: `shard_map` over a ``stage`` mesh axis, a static loop
+over schedule ticks, `lax.cond` selecting each device's stage work, and
+`jax.lax.ppermute` carrying the bottleneck + skips stage0→stage1 over ICI.
+
+Schedule shape (parity with §3.3 of SURVEY.md): S=2 stages, M microbatches
+(default 2, reference hardcodes 2 at unet_model.py:25). Ticks t=0..M: stage 0
+encodes microbatch t while stage 1 decodes microbatch t-1 — the classic
+1-warmup/1-drain GPipe bubble.
+
+Differentiation: the whole schedule is a pure function of the (replicated)
+params, so `jax.grad` through the `shard_map` gives the pipelined backward
+automatically — `ppermute`'s transpose is the reverse permute, so activation
+cotangents flow stage1→stage0 with the same overlap structure. Parameters are
+replicated across the stage axis (30 MB of params — replication is the right
+trade; what is *pipelined* is the activation traffic, which at
+(µB,640,960,32) per skip is the dominant term exactly as in the reference).
+Each device only *executes* its own stage's branch per tick; the inactive
+branch of `lax.cond` is not executed on TPU.
+
+The ('data', 'stage') hybrid falls out for free: batch sharded over 'data',
+schedule over 'stage'; `jax.grad`'s transpose inserts the gradient psum over
+'data' — that psum is the DDP all-reduce.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributedpytorch_tpu.ops.losses import bce_dice_stats, loss_from_stats
+
+
+def _zeros_like_tree(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+def _send_to_next_stage(tree, axis_name: str, num_stages: int):
+    """ppermute every leaf stage s → s+1 (last stage's output is dropped)."""
+    perm = [(s, s + 1) for s in range(num_stages - 1)]
+    return jax.tree.map(
+        lambda x: jax.lax.ppermute(x, axis_name, perm=perm), tree
+    )
+
+
+def make_pipeline_loss_fn(
+    model,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    stage_axis: str = "stage",
+    data_axis: str = None,
+    remat: bool = False,
+) -> Callable:
+    """Build ``loss_fn(params, batch) -> loss`` running the 2-stage GPipe
+    schedule over `mesh`'s ``stage`` axis.
+
+    `batch` is ``{'image': (B,H,W,3) f32, 'mask': (B,H,W,1) f32 target}``
+    with B divisible by num_microbatches (× data-axis size when hybrid).
+    Returns the same scalar loss as the non-pipelined step: the mean over the
+    full batch (microbatches are equal-sized, so mean-of-µmeans == mean).
+    """
+    num_stages = mesh.shape[stage_axis]
+    if num_stages != 2:
+        raise ValueError(
+            f"2-stage pipeline (reference cut, unet_model.py:16-20); got {num_stages}"
+        )
+    M = int(num_microbatches)
+
+    encode = model.encode_mid
+    decode = model.decode_head
+    if remat:
+        encode = jax.checkpoint(encode)
+        decode = jax.checkpoint(decode)
+
+    batch_spec = P(data_axis) if data_axis else P()
+    in_specs = (P(), {"image": batch_spec, "mask": batch_spec})
+    out_specs = P()
+
+    def per_device(params, batch):
+        stage = jax.lax.axis_index(stage_axis)
+        images = batch["image"]
+        masks = batch["mask"]
+        if images.shape[0] < M or images.shape[0] % M:
+            raise ValueError(
+                f"per-shard batch {images.shape[0]} must be a positive "
+                f"multiple of num_microbatches={M}"
+            )
+        mb = images.shape[0] // M  # microbatch size (static)
+
+        def encode_mb(t):
+            x = jax.lax.dynamic_slice_in_dim(images, t * mb, mb, axis=0)
+            bottleneck, skips = model.apply(
+                {"params": params}, x, method=encode
+            )
+            return bottleneck, skips
+
+        # Shape/dtype template for the inter-stage payload.
+        template = jax.eval_shape(lambda: encode_mb(0))
+        zero_payload = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), template
+        )
+
+        def decode_mb(payload, t):
+            bottleneck, skips = payload
+            preds = model.apply(
+                {"params": params}, bottleneck, skips, method=decode
+            )
+            target = jax.lax.dynamic_slice_in_dim(masks, t * mb, mb, axis=0)
+            # The log-dice term is a ratio of WHOLE-batch sums (reference
+            # utils.py:18-23 computes it on the concatenated pipe output), so
+            # microbatches accumulate sufficient statistics, not losses.
+            return bce_dice_stats(preds, target)
+
+        stats_sum = jnp.zeros((4,), jnp.float32)
+        in_flight = zero_payload
+        for t in range(M + 1):
+            # Stage 0 encodes microbatch t (ticks 0..M-1); other stages and
+            # drained ticks produce zeros that ppermute discards downstream.
+            produce = jnp.logical_and(stage == 0, t < M)
+            payload = jax.lax.cond(
+                produce,
+                lambda: encode_mb(min(t, M - 1)),
+                lambda: zero_payload,
+            )
+            # Stage 1 decodes microbatch t-1 (ticks 1..M) from what arrived
+            # last tick.
+            consume = jnp.logical_and(stage == num_stages - 1, t >= 1)
+            stats_t = jax.lax.cond(
+                consume,
+                functools.partial(decode_mb, in_flight),
+                lambda _unused: jnp.zeros((4,), jnp.float32),
+                max(t - 1, 0),
+            )
+            stats_sum = stats_sum + stats_t
+            # Move this tick's product to the next stage for tick t+1.
+            in_flight = _send_to_next_stage(payload, stage_axis, num_stages)
+
+        # Sum stats across the stage axis (stage 0 contributed zeros) and,
+        # in the hybrid, across data shards — the result is the EXACT
+        # full-global-batch loss, not an average of shard losses.
+        axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
+        stats = jax.lax.psum(stats_sum, axes)
+        return loss_from_stats(stats)
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+
+
+def make_pipeline_forward_fn(
+    model,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    stage_axis: str = "stage",
+    data_axis: str = None,
+) -> Callable:
+    """Pipelined inference: ``forward(params, images) -> preds``.
+
+    Same schedule as the loss path; predictions are ppermuted back to every
+    stage so the output is replicated across 'stage' (the reference's
+    ``.to('cuda:0')`` gather, unet_model.py:53).
+    """
+    num_stages = mesh.shape[stage_axis]
+    M = int(num_microbatches)
+    batch_spec = P(data_axis) if data_axis else P()
+
+    def per_device(params, images):
+        stage = jax.lax.axis_index(stage_axis)
+        mb = images.shape[0] // M
+
+        def encode_mb(t):
+            x = jax.lax.dynamic_slice_in_dim(images, t * mb, mb, axis=0)
+            return model.apply({"params": params}, x, method=model.encode_mid)
+
+        template = jax.eval_shape(lambda: encode_mb(0))
+        zero_payload = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+
+        def decode_mb(payload):
+            bottleneck, skips = payload
+            return model.apply(
+                {"params": params}, bottleneck, skips, method=model.decode_head
+            )
+
+        out_shape = (mb,) + images.shape[1:3] + (model.n_classes,)
+        preds = []
+        in_flight = zero_payload
+        for t in range(M + 1):
+            produce = jnp.logical_and(stage == 0, t < M)
+            payload = jax.lax.cond(
+                produce, lambda: encode_mb(min(t, M - 1)), lambda: zero_payload
+            )
+            consume = jnp.logical_and(stage == num_stages - 1, t >= 1)
+            pred_t = jax.lax.cond(
+                consume,
+                functools.partial(decode_mb, in_flight),
+                lambda: jnp.zeros(out_shape, jnp.float32),
+            )
+            if t >= 1:
+                preds.append(pred_t)
+            in_flight = _send_to_next_stage(payload, stage_axis, num_stages)
+
+        out = jnp.concatenate(preds, axis=0)
+        # Replicate across the stage axis: stage 1 holds the real output,
+        # stage 0 holds zeros → psum is a broadcast-from-last-stage.
+        return jax.lax.psum(out, stage_axis)
+
+    return shard_map(
+        per_device,
+        mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=batch_spec,
+        check_vma=False,
+    )

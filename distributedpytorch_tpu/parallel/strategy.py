@@ -1,0 +1,395 @@
+"""Strategy objects: mesh + shardings + process topology per train method.
+
+ONE trainer (train/loop.py) consumes these; each strategy answers:
+which mesh, how batches are placed/sharded, how the train step is jitted,
+which process does eval/checkpoint/metrics, how the dataloader is sharded,
+and how the lr scales — everything that differed between the reference's
+three copy-pasted `fit*` loops (SURVEY.md §2 duplication note).
+
+Method-name parity with the reference CLI (reference train.py:17, :46-64):
+``singleGPU`` (single device), ``DP``, ``DDP``, ``MP``, plus the new hybrid
+``DDP_MP``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributedpytorch_tpu.config import TrainConfig
+from distributedpytorch_tpu.data.loader import ShardSpec
+from distributedpytorch_tpu.parallel.pipeline import (
+    make_pipeline_forward_fn,
+    make_pipeline_loss_fn,
+)
+from distributedpytorch_tpu.train.steps import (
+    TrainState,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _prep_mask(mask: jax.Array) -> jax.Array:
+    return mask[..., None].astype(jnp.float32)
+
+
+class Strategy:
+    """Base: single-controller, no mesh (one device)."""
+
+    name = "base"
+
+    def __init__(self, config: TrainConfig):
+        self.config = config
+        self.mesh: Optional[Mesh] = None
+
+    # -- process topology ---------------------------------------------------
+    @property
+    def is_main(self) -> bool:
+        """Rank-0 gating for eval/checkpoint/metrics (reference
+        train_utils.py:229-248). Single-process strategies: always True."""
+        return jax.process_index() == 0
+
+    def data_shard(self) -> ShardSpec:
+        """How the dataloader shards samples across processes
+        (DistributedSampler parity, reference train_utils.py:189)."""
+        return ShardSpec(0, 1)
+
+    # -- batch semantics ----------------------------------------------------
+    @property
+    def global_batch_size(self) -> int:
+        """config.batch_size is the per-process batch (torch DataLoader
+        semantics); single-process strategies: global == local."""
+        return self.config.batch_size
+
+    @property
+    def drop_last_train(self) -> bool:
+        """Sharded strategies need the batch divisible by the data-axis
+        size; single device tolerates a ragged final batch (one extra XLA
+        compile for the remainder shape)."""
+        return False
+
+    def lr_for(self, base_lr: float) -> float:
+        return base_lr
+
+    # -- placement ----------------------------------------------------------
+    def place_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        dev = jax.devices()[0]
+        return {k: jax.device_put(v, dev) for k, v in batch.items()}
+
+    def place_state(self, state: TrainState) -> TrainState:
+        dev = jax.devices()[0]
+        return jax.device_put(state, dev)
+
+    # -- compiled steps -----------------------------------------------------
+    def build_train_step(self, model, tx) -> Callable:
+        # Quirk-1 scale uses the PER-PROCESS batch_size (the reference's `-b`
+        # value): fit_DDP scales by its local -b then mean-allreduces, so the
+        # global batch would overscale by world_size.
+        step = make_train_step(
+            model,
+            tx,
+            batch_size=self.config.batch_size,
+            faithful_loss_scaling=self.config.faithful_loss_scaling,
+        )
+        return jax.jit(step, donate_argnums=(0,))
+
+    def build_eval_step(self, model) -> Callable:
+        return jax.jit(make_eval_step(model))
+
+
+class SingleDevice(Strategy):
+    """Reference ``-t singleGPU`` (train.py:46-50): whole model + batch on
+    one chip."""
+
+    name = "singleGPU"
+
+
+def _replicate(mesh: Mesh, tree):
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+class DataParallel(Strategy):
+    """Reference ``-t DP`` (torch.nn.DataParallel, train_utils.py:98):
+    single process, batch split across local devices.
+
+    TPU-native form: a 1-axis ('data',) mesh over the process's devices,
+    batch NamedSharding'ed over 'data', params replicated; XLA's sharding
+    propagation inserts the gradient AllReduce that DataParallel does with
+    scatter/gather — without the per-step replica broadcast DataParallel
+    pays. config.batch_size stays the GLOBAL batch, like torch DP.
+    """
+
+    name = "DP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        super().__init__(config)
+        devs = list(devices if devices is not None else jax.local_devices())
+        if config.batch_size % len(devs) != 0:
+            # shrink the axis so the global batch divides it (torch DP allows
+            # uneven scatter; GSPMD does not)
+            n = len(devs)
+            while config.batch_size % n:
+                n -= 1
+            devs = devs[:n]
+        self.mesh = Mesh(np.array(devs), ("data",))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+
+    @property
+    def drop_last_train(self) -> bool:
+        return True
+
+    def place_batch(self, batch):
+        return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}
+
+    def place_state(self, state):
+        return _replicate(self.mesh, state)
+
+
+class DistributedDataParallel(DataParallel):
+    """Reference ``-t DDP`` (train_utils.py:170-248): multi-process data
+    parallel, one process per host, gradient all-reduce over ICI/DCN.
+
+    Differences vs DP (exactly the reference's):
+      * the mesh spans ALL processes' devices (`jax.devices()`, global);
+      * each process loads its own sample shard (`ShardSpec` = the
+        DistributedSampler, with the per-epoch reshuffle fix);
+      * config.batch_size is PER-PROCESS (global = b × world), matching the
+        torchrun launch convention (reference README.md:37);
+      * lr is scaled by the data-parallel degree when
+        ``ddp_lr_world_size_scaling`` (reference quirk 2, train_utils.py:199);
+      * eval/checkpoint/metrics on process 0 only.
+
+    Launch: `dist/runtime.py` maps torchrun-style env vars onto
+    `jax.distributed.initialize`. Under a single process this degrades to DP
+    over all local devices — which is also how it is unit-tested on the
+    8-device virtual CPU mesh.
+    """
+
+    name = "DDP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        Strategy.__init__(self, config)
+        devs = list(devices if devices is not None else jax.devices())
+        self.mesh = Mesh(np.array(devs), ("data",))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+
+    def data_shard(self) -> ShardSpec:
+        return ShardSpec(jax.process_index(), jax.process_count())
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.config.batch_size * jax.process_count()
+
+    def lr_for(self, base_lr: float) -> float:
+        if self.config.ddp_lr_world_size_scaling:
+            return base_lr * self.mesh.shape["data"]
+        return base_lr
+
+    def place_batch(self, batch):
+        if jax.process_count() == 1:
+            return {
+                k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()
+            }
+        return {
+            k: jax.make_array_from_process_local_data(self.batch_sharding, v)
+            for k, v in batch.items()
+        }
+
+
+class Pipeline(Strategy):
+    """Reference ``-t MP`` (unet_model.py:14-53): 2-stage microbatched
+    pipeline — encoder+mid on stage 0, decoder+head on stage 1, explicit
+    GPipe schedule over a ('stage',) mesh (see parallel/pipeline.py)."""
+
+    name = "MP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        super().__init__(config)
+        devs = list(devices if devices is not None else jax.local_devices())
+        if len(devs) < config.num_stages:
+            raise ValueError(
+                f"Requires at least {config.num_stages} devices, got {len(devs)}"
+            )
+        self.mesh = Mesh(np.array(devs[: config.num_stages]), ("stage",))
+        self.batch_sharding = NamedSharding(self.mesh, P())  # replicated
+
+    def place_batch(self, batch):
+        return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}
+
+    def place_state(self, state):
+        return _replicate(self.mesh, state)
+
+    def _loss_fn(self, model):
+        return make_pipeline_loss_fn(
+            model,
+            self.mesh,
+            num_microbatches=self.config.num_microbatches,
+            data_axis=None,
+        )
+
+    def build_train_step(self, model, tx) -> Callable:
+        pipeline_loss = self._loss_fn(model)
+        # per-process batch, same rationale as Strategy.build_train_step
+        grad_scale = (
+            float(self.config.batch_size)
+            if self.config.faithful_loss_scaling
+            else 1.0
+        )
+
+        def step(state: TrainState, batch):
+            prepped = {"image": batch["image"], "mask": _prep_mask(batch["mask"])}
+            loss, grads = jax.value_and_grad(
+                lambda p: pipeline_loss(p, prepped)
+            )(state.params)
+            if grad_scale != 1.0:
+                grads = jax.tree.map(lambda g: g * grad_scale, grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+                loss,
+            )
+
+        return jax.jit(step, donate_argnums=(0,))
+
+    def build_eval_step(self, model) -> Callable:
+        # Eval runs the pipelined forward too (the reference evaluates
+        # through the pipe model, train.py:62-64 → evaluate.py).
+        fwd = make_pipeline_forward_fn(
+            model, self.mesh, num_microbatches=self.config.num_microbatches
+        )
+        from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
+
+        def eval_step(params, batch):
+            preds = fwd(params, batch["image"])
+            target = _prep_mask(batch["mask"])
+            return {
+                "loss": bce_dice_loss(preds, target),
+                "dice": dice_coefficient(preds, target),
+            }
+
+        return jax.jit(eval_step)
+
+
+class HybridDataPipeline(Pipeline):
+    """``-t DDP_MP``: data parallel × pipeline on a 2-D ('data','stage')
+    mesh — the capability the reference lacks but the driver's north star
+    adds (SURVEY.md §2 checklist). Batch sharded over 'data'; each data
+    replica runs the 2-stage schedule over its 'stage' pair; the gradient
+    psum over 'data' is the DDP all-reduce, inserted by autodiff."""
+
+    name = "DDP_MP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        Strategy.__init__(self, config)
+        devs = list(devices if devices is not None else jax.devices())
+        stages = config.num_stages
+        if len(devs) < 2 * stages:
+            raise ValueError(
+                f"DDP_MP needs at least {2*stages} devices, got {len(devs)}"
+            )
+        # Each data shard must hold ≥1 full microbatch set: shrink the data
+        # degree until batch divides dp × microbatches (mirrors DataParallel's
+        # mesh shrink for indivisible batches).
+        per_process = config.batch_size
+        mb = config.num_microbatches
+        if per_process % mb:
+            raise ValueError(
+                f"batch_size {per_process} must be a multiple of "
+                f"num_microbatches {mb}"
+            )
+        dp = min(len(devs) // stages, per_process // mb)
+        while per_process % (dp * mb):
+            dp -= 1
+        if dp < 2:
+            raise ValueError(
+                f"DDP_MP degenerates to plain MP: batch_size {per_process} with "
+                f"{mb} microbatches leaves no room for a data axis ≥ 2 — "
+                f"use -t MP or raise the batch size"
+            )
+        self.mesh = Mesh(
+            np.array(devs[: dp * stages]).reshape(dp, stages), ("data", "stage")
+        )
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+
+    @property
+    def drop_last_train(self) -> bool:
+        return True
+
+    @property
+    def global_batch_size(self) -> int:
+        return self.config.batch_size * jax.process_count()
+
+    def data_shard(self) -> ShardSpec:
+        return ShardSpec(jax.process_index(), jax.process_count())
+
+    def lr_for(self, base_lr: float) -> float:
+        if self.config.ddp_lr_world_size_scaling:
+            return base_lr * self.mesh.shape["data"]
+        return base_lr
+
+    def place_batch(self, batch):
+        if jax.process_count() == 1:
+            return {
+                k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()
+            }
+        return {
+            k: jax.make_array_from_process_local_data(self.batch_sharding, v)
+            for k, v in batch.items()
+        }
+
+    def _loss_fn(self, model):
+        return make_pipeline_loss_fn(
+            model,
+            self.mesh,
+            num_microbatches=self.config.num_microbatches,
+            data_axis="data",
+        )
+
+    def build_eval_step(self, model) -> Callable:
+        fwd = make_pipeline_forward_fn(
+            model,
+            self.mesh,
+            num_microbatches=self.config.num_microbatches,
+            data_axis="data",
+        )
+        from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
+
+        def eval_step(params, batch):
+            preds = fwd(params, batch["image"])
+            target = _prep_mask(batch["mask"])
+            return {
+                "loss": bce_dice_loss(preds, target),
+                "dice": dice_coefficient(preds, target),
+            }
+
+        return jax.jit(eval_step)
+
+
+STRATEGIES = {
+    cls.name: cls
+    for cls in (
+        SingleDevice,
+        DataParallel,
+        DistributedDataParallel,
+        Pipeline,
+        HybridDataPipeline,
+    )
+}
+
+
+def build_strategy(config: TrainConfig, devices=None) -> Strategy:
+    try:
+        cls = STRATEGIES[config.train_method]
+    except KeyError:
+        raise ValueError(
+            f"Unknown train method {config.train_method!r}; "
+            f"expected one of {sorted(STRATEGIES)}"
+        ) from None
+    return cls(config, devices) if cls is not SingleDevice else cls(config)

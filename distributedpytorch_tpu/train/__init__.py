@@ -1,0 +1,16 @@
+"""Training subsystem: ONE functional trainer parameterized by a strategy.
+
+The reference has three ~70-line copy-pasted loops (`fit`, `fit_DP`,
+`fit_DDP`, reference utils/train_utils.py:22-248); here there is one jitted
+train step (train/steps.py), one epoch driver (train/loop.py), and a family
+of strategy objects (parallel/) that differ only in mesh + shardings +
+process topology. SURVEY.md §7 design stance.
+"""
+
+from distributedpytorch_tpu.train.steps import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_eval_step,
+    make_train_step,
+)
+from distributedpytorch_tpu.train.loop import Trainer, fit  # noqa: F401

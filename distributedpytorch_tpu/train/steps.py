@@ -1,0 +1,107 @@
+"""The single train/eval step pair every strategy jits.
+
+Semantics parity with the reference hot loop (reference
+utils/train_utils.py:59-70):
+
+  * forward → BCE − log-dice on the sigmoid probabilities;
+  * the backward runs on ``batch_size × loss`` while the RECORDED loss is the
+    unscaled value (train_utils.py:67-69) — reference quirk 1, reproduced
+    behind ``TrainConfig.faithful_loss_scaling`` (near-no-op under Adam, see
+    SURVEY.md §2);
+  * masks arrive as integer (B, H, W); the ``unsqueeze(1)`` channel fix-up
+    (train_utils.py:61) becomes a trailing-axis expand — applied in EVERY
+    strategy, which deliberately fixes the reference's DP crash (quirk 4);
+  * Adam update with the lr read from optimizer state (ops/optim.py), so the
+    host-side plateau scheduler never recompiles the step.
+
+TPU notes: the model computes in bfloat16 (MXU) with float32 params and a
+float32 loss; the grad is taken w.r.t. float32 params directly — XLA inserts
+the casts once at trace time. Inputs are NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
+from distributedpytorch_tpu.ops.optim import adam_l2
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Pure-pytree training state (params + Adam state + step counter)."""
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+
+def create_train_state(
+    params,
+    learning_rate: float,
+    weight_decay: float = 1e-8,
+) -> Tuple[TrainState, optax.GradientTransformation]:
+    tx = adam_l2(learning_rate, weight_decay)
+    return (
+        TrainState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)),
+        tx,
+    )
+
+
+def _prep_mask(mask: jax.Array) -> jax.Array:
+    """(B, H, W) integer mask → (B, H, W, 1) float32 target (the reference's
+    `.unsqueeze(1)` + `.to(float32)`, train_utils.py:61 — channel-last here)."""
+    return mask[..., None].astype(jnp.float32)
+
+
+def loss_fn(model, params, batch: Dict[str, jax.Array]) -> jax.Array:
+    preds = model.apply({"params": params}, batch["image"])
+    return bce_dice_loss(preds, _prep_mask(batch["mask"]))
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    batch_size: int,
+    faithful_loss_scaling: bool = True,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
+    """Build the (unjitted) train step; the strategy decides how to jit/shard
+    it. Returns ``step(state, batch) -> (state, unscaled_loss)``."""
+
+    grad_scale = float(batch_size) if faithful_loss_scaling else 1.0
+
+    def train_step(state: TrainState, batch: Dict[str, jax.Array]):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(model, p, batch)
+        )(state.params)
+        if grad_scale != 1.0:
+            # (batch_size * loss).backward() parity, reference train_utils.py:69
+            grads = jax.tree.map(lambda g: g * grad_scale, grads)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return train_step
+
+
+def make_eval_step(model) -> Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]:
+    """Eval step: per-batch mean loss (reference evaluate.py:16-19) plus the
+    hard-Dice metric the reference never computes (SURVEY.md §2 quirk 6)."""
+
+    def eval_step(params, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        preds = model.apply({"params": params}, batch["image"])
+        target = _prep_mask(batch["mask"])
+        return {
+            "loss": bce_dice_loss(preds, target),
+            "dice": dice_coefficient(preds, target),
+        }
+
+    return eval_step

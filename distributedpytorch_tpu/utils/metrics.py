@@ -1,0 +1,82 @@
+"""Loss/throughput records with reference artifact parity.
+
+The reference's only observability is (a) a message-only logfile and (b)
+pandas DataFrames pickled to ``./loss/{method}/{train,val}_loss.pkl`` with
+columns ``['Step', 'Time', 'Loss']`` — a train row every 10 steps holding the
+mean of the last ≤10 losses, and a val row per epoch (reference
+utils/train_utils.py:75-79, 82-84, 89-92). `LossRecords` reproduces that
+format exactly (it is the imgs/sec comparison source, SURVEY.md §6) and adds
+what the reference lacks: imgs/sec accounting and a val-Dice column written
+to a separate file so the pickle schema stays reference-compatible.
+
+Unlike the reference, the output directory is created on demand — the
+reference crashes at save time because ``./loss/{method}/`` never exists
+(SURVEY.md §2 component 13).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class LossRecords:
+    """Accumulates train/val loss rows and writes reference-format pickles."""
+
+    def __init__(self, method_tag: str, loss_dir: str = "./loss", every: int = 10):
+        self.method_tag = method_tag
+        self.loss_dir = loss_dir
+        self.every = every
+        self.start_time = time.time()
+        self.losses: List[float] = []
+        self.train_rows: List[list] = []  # [step, time_s, mean-of-last-10 loss]
+        self.val_rows: List[list] = []  # [step, time_s, val loss]
+        self.dice_rows: List[list] = []  # [step, time_s, val dice] (new)
+        self.images_seen = 0
+
+    def record_train(self, step: int, loss, batch_images: int = 0) -> None:
+        """Call once per optimizer step with the UNSCALED loss
+        (reference train_utils.py:67, 75-79).
+
+        `loss` may be a device scalar: it is kept unforced and converted to
+        host floats only when a metrics row is due, so the train loop stays
+        dispatch-async between rows (one host sync per `every` steps)."""
+        self.losses.append(loss)
+        self.images_seen += batch_images
+        if step % self.every == 0:
+            window = [float(x) for x in self.losses[-self.every :]]
+            self.losses[-self.every :] = window
+            self.train_rows.append([step, time.time() - self.start_time, float(np.mean(window))])
+
+    def record_val(self, step: int, val_loss: float, val_dice: Optional[float] = None) -> None:
+        now = time.time() - self.start_time
+        self.val_rows.append([step, now, float(val_loss)])
+        if val_dice is not None:
+            self.dice_rows.append([step, now, float(val_dice)])
+
+    @property
+    def elapsed(self) -> float:
+        return time.time() - self.start_time
+
+    def images_per_second(self) -> float:
+        dt = self.elapsed
+        return self.images_seen / dt if dt > 0 else 0.0
+
+    def save(self) -> None:
+        """Write ``{train,val}_loss.pkl`` (reference schema) + ``val_dice.pkl``."""
+        import pandas as pd
+
+        out = os.path.join(self.loss_dir, self.method_tag)
+        os.makedirs(out, exist_ok=True)
+        pd.DataFrame(self.train_rows, columns=["Step", "Time", "Loss"]).to_pickle(
+            os.path.join(out, "train_loss.pkl")
+        )
+        pd.DataFrame(self.val_rows, columns=["Step", "Time", "Loss"]).to_pickle(
+            os.path.join(out, "val_loss.pkl")
+        )
+        pd.DataFrame(self.dice_rows, columns=["Step", "Time", "Dice"]).to_pickle(
+            os.path.join(out, "val_dice.pkl")
+        )

@@ -1,0 +1,164 @@
+"""Data pipeline tests: preprocess parity, pairing asserts, split/shard
+determinism (reference utils/dataloading.py; SURVEY.md §4 test strategy)."""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributedpytorch_tpu.data import (
+    BasicDataset,
+    CarvanaDataset,
+    DataLoader,
+    ShardSpec,
+    SyntheticSegmentationDataset,
+    build_dataset,
+    seeded_split,
+    write_synthetic_carvana_tree,
+)
+
+
+@pytest.fixture(scope="module")
+def carvana_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("carvana")
+    images, masks = write_synthetic_carvana_tree(str(root), n=8, size_wh=(96, 64))
+    return images, masks
+
+
+def test_carvana_dataset_items(carvana_tree):
+    images, masks = carvana_tree
+    ds = CarvanaDataset(images, masks, newsize=(48, 32))
+    assert len(ds) == 8
+    item = ds[0]
+    # NHWC float image in [0,1]; integer HW mask (reference dataloading.py:70-73,
+    # layout deliberately NHWC not CHW)
+    assert item["image"].shape == (32, 48, 3)
+    assert item["image"].dtype == np.float32
+    assert 0.0 <= item["image"].min() and item["image"].max() <= 1.0
+    assert item["mask"].shape == (32, 48)
+    assert item["mask"].dtype == np.int32
+    assert set(np.unique(item["mask"])) <= {0, 1}  # Carvana masks are {0,1}
+
+
+def test_preprocess_resize_filters():
+    # BICUBIC for images, NEAREST for masks (reference dataloading.py:31):
+    # a 0/1 checkerboard mask must stay exactly {0,1} after resize.
+    checker = np.indices((8, 8)).sum(0) % 2
+    mask_img = Image.fromarray(checker.astype(np.uint8))
+    out = BasicDataset.preprocess(mask_img, (5, 3), is_mask=True)
+    assert set(np.unique(out)) <= {0, 1}
+    # BICUBIC on a smooth ramp interpolates (values between the endpoints)
+    ramp = np.linspace(0, 255, 64, dtype=np.uint8).reshape(8, 8)
+    img = Image.fromarray(np.stack([ramp] * 3, -1))
+    out = BasicDataset.preprocess(img, (5, 3), is_mask=False)
+    assert out.shape == (3, 5, 3)
+    assert out.max() <= 1.0
+
+
+def test_grayscale_image_gets_channel():
+    gray = Image.fromarray(np.zeros((8, 8), np.uint8))
+    out = BasicDataset.preprocess(gray, (8, 8), is_mask=False)
+    assert out.shape == (8, 8, 1)
+
+
+def test_pairing_asserts(tmp_path, carvana_tree):
+    images, _ = carvana_tree
+    # masks dir without the _mask files → every Carvana lookup fails
+    empty = tmp_path / "no_masks"
+    empty.mkdir()
+    ds = CarvanaDataset(images, str(empty), newsize=(48, 32))
+    with pytest.raises(AssertionError):
+        ds[0]
+
+
+def test_build_dataset_fallback(carvana_tree, tmp_path):
+    images, masks = carvana_tree
+    assert isinstance(build_dataset(images, masks, (48, 32)), CarvanaDataset)
+    # non-Carvana naming (masks without suffix) → BasicDataset fallback
+    # (reference train_utils.py:27-32)
+    alt_imgs = tmp_path / "imgs"
+    alt_masks = tmp_path / "masks"
+    alt_imgs.mkdir(), alt_masks.mkdir()
+    arr = np.zeros((8, 8, 3), np.uint8)
+    Image.fromarray(arr).save(alt_imgs / "a.png")
+    Image.fromarray(arr[..., 0]).save(alt_masks / "a.png")
+    ds = build_dataset(str(alt_imgs), str(alt_masks), (8, 8))
+    assert isinstance(ds, BasicDataset) and not isinstance(ds, CarvanaDataset)
+    assert ds[0]["image"].shape == (8, 8, 3)
+
+
+def test_empty_dir_raises(tmp_path):
+    (tmp_path / "empty").mkdir()
+    with pytest.raises(RuntimeError):
+        BasicDataset(str(tmp_path / "empty"), str(tmp_path / "empty"))
+
+
+def test_seeded_split_deterministic():
+    tr1, va1 = seeded_split(100, 0.10, seed=0)
+    tr2, va2 = seeded_split(100, 0.10, seed=0)
+    np.testing.assert_array_equal(tr1, tr2)
+    np.testing.assert_array_equal(va1, va2)
+    assert len(va1) == 10 and len(tr1) == 90
+    assert set(tr1) | set(va1) == set(range(100))
+    tr3, _ = seeded_split(100, 0.10, seed=1)
+    assert not np.array_equal(tr1, tr3)
+
+
+def test_shard_spec_partition():
+    order = np.arange(10)
+    shards = [ShardSpec(r, 4).shard(order) for r in range(4)]
+    # padded to 12 by wrap-around (DistributedSampler semantics): every shard
+    # equal length, union covers all samples
+    assert all(len(s) == 3 for s in shards)
+    assert set(np.concatenate(shards)) == set(range(10))
+
+
+def test_shard_spec_world_larger_than_dataset():
+    # world > len(order): repeat-then-truncate must still give every rank
+    # exactly one sample (a rank with 0 samples would deadlock a collective)
+    order = np.arange(3)
+    shards = [ShardSpec(r, 8).shard(order) for r in range(8)]
+    assert all(len(s) == 1 for s in shards)
+    assert set(np.concatenate(shards)) == set(range(3))
+
+
+def test_loader_epoch_reshuffle_and_shard_disjointness():
+    ds = SyntheticSegmentationDataset(length=16, newsize=(16, 8))
+    loaders = [
+        DataLoader(
+            ds, batch_size=2, shuffle=True, seed=7, shard=ShardSpec(r, 2)
+        )
+        for r in range(2)
+    ]
+
+    def epoch_ids(loader, epoch):
+        return list(loader._epoch_order(epoch))
+
+    e0 = [epoch_ids(l, 0) for l in loaders]
+    e1 = [epoch_ids(l, 1) for l in loaders]
+    # set_epoch fix: different epochs → different order (reference bug: same
+    # shuffle every epoch, SURVEY.md §3.2)
+    assert e0[0] != e1[0]
+    # shards disjoint & complete within an epoch
+    assert set(e0[0]) | set(e0[1]) == set(range(16))
+    assert set(e0[0]) & set(e0[1]) == set()
+
+
+def test_loader_batches_and_drop_last():
+    ds = SyntheticSegmentationDataset(length=10, newsize=(16, 8))
+    loader = DataLoader(ds, batch_size=4, drop_last=True)
+    batches = list(loader.epoch_batches(0))
+    assert len(batches) == 2 == len(loader)
+    assert batches[0]["image"].shape == (4, 8, 16, 3)
+    assert batches[0]["mask"].shape == (4, 8, 16)
+    loader2 = DataLoader(ds, batch_size=4, drop_last=False)
+    sizes = [b["image"].shape[0] for b in loader2.epoch_batches(0)]
+    assert sizes == [4, 4, 2]
+
+
+def test_threaded_loader_matches_sync():
+    ds = SyntheticSegmentationDataset(length=12, newsize=(16, 8))
+    sync = DataLoader(ds, batch_size=3, shuffle=True, seed=3, num_workers=0)
+    threaded = DataLoader(ds, batch_size=3, shuffle=True, seed=3, num_workers=4)
+    for bs, bt in zip(sync.epoch_batches(5), threaded.epoch_batches(5)):
+        np.testing.assert_array_equal(bs["image"], bt["image"])
+        np.testing.assert_array_equal(bs["mask"], bt["mask"])

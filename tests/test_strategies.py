@@ -1,0 +1,178 @@
+"""Strategy equivalence tests on the 8-device virtual CPU mesh.
+
+The load-bearing guarantee of the one-trainer design: every strategy
+computes the SAME loss and the SAME gradients as the single-device step
+(up to float tolerance) — DP/DDP via GSPMD sharding, MP/DDP_MP via the
+explicit shard_map GPipe schedule (SURVEY.md §7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.config import TrainConfig
+from distributedpytorch_tpu.models.unet import UNet
+from distributedpytorch_tpu.ops.losses import bce_dice_loss
+from distributedpytorch_tpu.parallel import build_strategy
+from distributedpytorch_tpu.parallel.pipeline import (
+    make_pipeline_forward_fn,
+    make_pipeline_loss_fn,
+)
+from distributedpytorch_tpu.train.steps import create_train_state, make_train_step
+
+# Small shapes: H,W divisible by 16; float32 compute for exact comparisons.
+# B=8 covers every strategy on the 8-device mesh (hybrid needs
+# data_shards(4) × microbatches(2) = 8).
+H, W, B = 32, 48, 8
+
+
+@pytest.fixture(scope="module")
+def model():
+    return UNet(dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(0), jnp.zeros((1, H, W, 3)))["params"]
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.default_rng(0)
+    return {
+        "image": rng.random((B, H, W, 3), dtype=np.float32),
+        "mask": (rng.random((B, H, W)) > 0.5).astype(np.int32),
+    }
+
+
+def _prep(batch):
+    return {
+        "image": jnp.asarray(batch["image"]),
+        "mask": jnp.asarray(batch["mask"])[..., None].astype(jnp.float32),
+    }
+
+
+def _ref_loss_and_grads(model, params, batch):
+    def loss_fn(p):
+        preds = model.apply({"params": p}, jnp.asarray(batch["image"]))
+        target = jnp.asarray(batch["mask"])[..., None].astype(jnp.float32)
+        return bce_dice_loss(preds, target)
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    flat_a, _ = jax.tree.flatten(a)
+    flat_b, _ = jax.tree.flatten(b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=rtol, atol=atol)
+
+
+def _config(method, **kw):
+    return TrainConfig(
+        train_method=method,
+        batch_size=B,
+        compute_dtype="float32",
+        image_size=(W, H),
+        **kw,
+    )
+
+
+class TestPipelineNumerics:
+    def test_pipeline_loss_matches_plain(self, model, params, batch):
+        cfg = _config("MP")
+        strat = build_strategy(cfg)
+        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
+        ref_loss, _ = _ref_loss_and_grads(model, params, batch)
+        pipe_loss = loss_fn(params, _prep(batch))
+        np.testing.assert_allclose(
+            float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6
+        )
+
+    def test_pipeline_grads_match_plain(self, model, params, batch):
+        cfg = _config("MP")
+        strat = build_strategy(cfg)
+        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
+        _, ref_grads = _ref_loss_and_grads(model, params, batch)
+        pipe_grads = jax.grad(lambda p: loss_fn(p, _prep(batch)))(params)
+        _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
+
+    def test_pipeline_forward_matches_plain(self, model, params, batch):
+        cfg = _config("MP")
+        strat = build_strategy(cfg)
+        fwd = make_pipeline_forward_fn(model, strat.mesh, num_microbatches=2)
+        ref = model.apply({"params": params}, jnp.asarray(batch["image"]))
+        out = fwd(params, jnp.asarray(batch["image"]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def test_four_microbatches(self, model, params, batch):
+        cfg = _config("MP", num_microbatches=4)
+        strat = build_strategy(cfg)
+        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=4)
+        ref_loss, _ = _ref_loss_and_grads(model, params, batch)
+        np.testing.assert_allclose(
+            float(loss_fn(params, _prep(batch))), float(ref_loss), rtol=1e-5, atol=1e-6
+        )
+
+    def test_hybrid_loss_and_grads(self, model, params, batch):
+        cfg = _config("DDP_MP")
+        strat = build_strategy(cfg)
+        assert dict(strat.mesh.shape) == {"data": 4, "stage": 2}
+        loss_fn = make_pipeline_loss_fn(
+            model, strat.mesh, num_microbatches=2, data_axis="data"
+        )
+        ref_loss, ref_grads = _ref_loss_and_grads(model, params, batch)
+        prepped = _prep(batch)
+        pipe_loss, pipe_grads = jax.jit(
+            jax.value_and_grad(lambda p: loss_fn(p, prepped))
+        )(params)
+        np.testing.assert_allclose(float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6)
+        _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
+
+
+class TestStrategySteps:
+    """Full train-step equivalence: one Adam step under each strategy lands
+    on the same params."""
+
+    def _stepped_params(self, strategy, model, params, batch, cfg):
+        # copy: the jitted step donates its state, and place_state may alias
+        # the shared fixture arrays when they already sit on the right device
+        params = jax.tree.map(jnp.array, params)
+        state, tx = create_train_state(params, cfg.learning_rate, cfg.weight_decay)
+        state = strategy.place_state(state)
+        step = strategy.build_train_step(model, tx)
+        placed = strategy.place_batch(batch)
+        new_state, loss = step(state, placed)
+        return jax.device_get(new_state.params), float(loss)
+
+    @pytest.fixture(scope="class")
+    def single_result(self, model, params, batch):
+        cfg = _config("singleGPU")
+        strat = build_strategy(cfg)
+        return self._stepped_params(strat, model, params, batch, cfg)
+
+    @pytest.mark.parametrize("method", ["DP", "DDP", "MP", "DDP_MP"])
+    def test_step_matches_single(self, method, model, params, batch, single_result):
+        cfg = _config(method, ddp_lr_world_size_scaling=False)
+        strat = build_strategy(cfg)
+        got_params, got_loss = self._stepped_params(strat, model, params, batch, cfg)
+        ref_params, ref_loss = single_result
+        np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-5, atol=1e-6)
+        # Post-step params can differ by up to 2·lr where reduction-order
+        # noise flips the sign of a near-zero grad (Adam normalizes every
+        # grad to ±lr). atol 3e-4 (≈3·lr) still catches wrong-lr / wrong-
+        # batch plumbing; exact GRAD equality is covered in
+        # TestPipelineNumerics.
+        _tree_allclose(ref_params, got_params, rtol=5e-4, atol=3e-4)
+
+    def test_ddp_lr_scaling_quirk(self, batch):
+        # reference quirk 2: lr × world_size (train_utils.py:199)
+        cfg = _config("DDP", ddp_lr_world_size_scaling=True)
+        strat = build_strategy(cfg)
+        assert strat.lr_for(1e-4) == pytest.approx(1e-4 * 8)
+        cfg2 = _config("DDP", ddp_lr_world_size_scaling=False)
+        assert build_strategy(cfg2).lr_for(1e-4) == pytest.approx(1e-4)
+
+    def test_unknown_method_raises(self):
+        with pytest.raises(ValueError, match="Unknown train method"):
+            build_strategy(_config("FSDP9000"))
