@@ -123,7 +123,28 @@ class DataLoader:
             order = rng.permutation(order)
         return self.shard_spec.shard(order)
 
-    def _assemble(self, items: List[dict]) -> Batch:
+    def _load_batch(self, idx_list) -> Batch:
+        """Assemble one batch; uses the native C++ whole-batch path (decode +
+        resize + normalize, threaded in C, see data/native.py) when the
+        dataset is filesystem-backed with supported formats."""
+        ds = self.dataset
+        if getattr(ds, "use_native", False) and hasattr(ds, "resolve_paths"):
+            from distributedpytorch_tpu.data import native
+
+            if native.get_lib() is not None:
+                paths = [ds.resolve_paths(int(i)) for i in idx_list]
+                if all(
+                    native.supports(p) and native.supports(m) for p, m in paths
+                ):
+                    imgs, masks = native.load_batch(
+                        [p for p, _ in paths],
+                        [m for _, m in paths],
+                        ds.newsize[0],
+                        ds.newsize[1],
+                        n_threads=max(self.num_workers, 4),
+                    )
+                    return {"image": imgs, "mask": masks}
+        items = [ds[int(i)] for i in idx_list]
         return {
             "image": np.stack([it["image"] for it in items]),
             "mask": np.stack([it["mask"] for it in items]),
@@ -137,33 +158,26 @@ class DataLoader:
             else len(order)
         )
         order = order[:cut]
-        starts = range(0, len(order), self.batch_size)
+        slices = [
+            order[s : s + self.batch_size]
+            for s in range(0, len(order), self.batch_size)
+        ]
         if self._pool is None:
-            for s in starts:
-                yield self._assemble(
-                    [self.dataset[int(i)] for i in order[s : s + self.batch_size]]
-                )
+            for idx in slices:
+                yield self._load_batch(idx)
             return
 
-        # Threaded prefetch: keep up to 2 batches of item-futures in flight.
-        def submit(s):
-            return [
-                self._pool.submit(self.dataset.__getitem__, int(i))
-                for i in order[s : s + self.batch_size]
-            ]
-
-        pending: List = []
-        starts = list(starts)
+        # Pipelined prefetch: keep up to 2 whole-batch futures in flight
+        # (the native path threads across items inside each batch in C++).
         depth = 2
-        for s in starts[:depth]:
-            pending.append(submit(s))
+        pending = [self._pool.submit(self._load_batch, s) for s in slices[:depth]]
         next_submit = depth
         while pending:
-            futures = pending.pop(0)
-            if next_submit < len(starts):
-                pending.append(submit(starts[next_submit]))
+            fut = pending.pop(0)
+            if next_submit < len(slices):
+                pending.append(self._pool.submit(self._load_batch, slices[next_submit]))
                 next_submit += 1
-            yield self._assemble([f.result() for f in futures])
+            yield fut.result()
 
     def __iter__(self) -> Iterator[Batch]:
         return self.epoch_batches(0)

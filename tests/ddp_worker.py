@@ -1,0 +1,75 @@
+"""Worker for the multi-process DDP integration test (test_multiprocess.py).
+
+Launched once per rank with torchrun-style env (RANK / WORLD_SIZE /
+MASTER_ADDR / MASTER_PORT) — the exact contract `dist/runtime.py` maps onto
+`jax.distributed.initialize` (reference launch: README.md:37). Trains a tiny
+synthetic run under `-t DDP` and writes a params fingerprint per rank so the
+parent can assert replicas stayed in sync through the gradient all-reduce.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    out_dir = sys.argv[1]
+
+    from distributedpytorch_tpu.dist import initialize_from_env, shutdown
+
+    runtime = initialize_from_env()
+
+    import jax
+
+    assert jax.process_count() == int(os.environ["WORLD_SIZE"]), (
+        jax.process_count(),
+        os.environ["WORLD_SIZE"],
+    )
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.train import Trainer
+
+    config = TrainConfig(
+        train_method="DDP",
+        epochs=1,
+        batch_size=4,  # per-process, like the reference's -b
+        learning_rate=1e-4,
+        val_percent=25.0,
+        seed=42,
+        compute_dtype="float32",
+        image_size=(48, 32),
+        synthetic_samples=32,
+        checkpoint_dir=os.path.join(out_dir, "checkpoints"),
+        log_dir=os.path.join(out_dir, "logs"),
+        loss_dir=os.path.join(out_dir, "loss"),
+        metric_every_steps=1,
+        num_workers=0,
+    )
+    trainer = Trainer(config)
+    result = trainer.train()
+
+    params_host = jax.device_get(trainer.state.params)
+    fingerprint = float(
+        sum(float(np.abs(np.asarray(p)).sum()) for p in jax.tree.leaves(params_host))
+    )
+    rank = runtime.process_id
+    with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+        json.dump(
+            {
+                "rank": rank,
+                "fingerprint": fingerprint,
+                "val_loss": result["val_loss"],
+                "steps": result["steps"],
+                "mesh_data": trainer.strategy.mesh.shape["data"],
+            },
+            f,
+        )
+    shutdown()
+
+
+if __name__ == "__main__":
+    main()
