@@ -34,7 +34,10 @@ from typing import Dict, Sequence, Tuple
 import numpy as np
 from PIL import Image
 
-from distributedpytorch_tpu.data import native
+try:
+    from distributedpytorch_tpu.data import native
+except ImportError:  # pragma: no cover - a broken/absent native layer must
+    native = None  # never make the package unimportable (VERDICT.md round 2)
 
 logger = logging.getLogger(__name__)
 
@@ -123,7 +126,12 @@ class BasicDataset:
     def __getitem__(self, idx: int) -> Item:
         img_path, mask_path = self.resolve_paths(idx)
 
-        if self.use_native and native.supports(img_path) and native.supports(mask_path):
+        if (
+            self.use_native
+            and native is not None
+            and native.supports(img_path)
+            and native.supports(mask_path)
+        ):
             if native.get_lib() is not None:
                 image, mask = native.load_item(
                     img_path, mask_path, self.newsize[0], self.newsize[1]

@@ -404,13 +404,32 @@ void resize_nearest(const Image& in, int out_w, int out_h, Image& out) {
   out.channels = in.channels;
   out.pix.resize(size_t(out_w) * out_h * in.channels);
   int C = in.channels;
-  // PIL NEAREST: src = floor((dst + 0.5) * in/out)
+  // Pillow NEAREST resize = ImagingTransformAffine: source coordinates are
+  // produced by REPEATED ADDITION of the scale from a half-pixel origin
+  // (xx = scale/2; per pixel: src = int(xx); xx += scale), then truncated.
+  // The floating-point drift of that accumulation is observable in Pillow's
+  // output on upscales (e.g. 4→7 picks index 1 where direct multiplication
+  // gives exactly 2.0), so a closed-form src = int((dst+0.5)*in/out) is NOT
+  // Pillow-exact. Replicate the accumulation bit-for-bit.
+  const double xscale = double(in.w) / out_w;
+  const double yscale = double(in.h) / out_h;
+  std::vector<int> xmap(out_w);
+  double xx = xscale * 0.5;
+  for (int x = 0; x < out_w; x++) {
+    xmap[x] = std::min(in.w - 1, int(xx));
+    xx += xscale;
+  }
+  double yy = yscale * 0.5;
   for (int y = 0; y < out_h; y++) {
-    int sy = std::min(in.h - 1, int((y + 0.5) * in.h / out_h));
-    for (int x = 0; x < out_w; x++) {
-      int sx = std::min(in.w - 1, int((x + 0.5) * in.w / out_w));
-      memcpy(out.pix.data() + (size_t(y) * out_w + x) * C,
-             in.pix.data() + (size_t(sy) * in.w + sx) * C, C);
+    int sy = std::min(in.h - 1, int(yy));
+    yy += yscale;
+    const uint8_t* srow = in.pix.data() + size_t(sy) * in.w * C;
+    uint8_t* drow = out.pix.data() + size_t(y) * out_w * C;
+    if (C == 1) {
+      for (int x = 0; x < out_w; x++) drow[x] = srow[xmap[x]];
+    } else {
+      for (int x = 0; x < out_w; x++)
+        memcpy(drow + size_t(x) * C, srow + size_t(xmap[x]) * C, C);
     }
   }
 }
