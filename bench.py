@@ -2,44 +2,92 @@
 """Benchmark harness: UNet training throughput on the available hardware.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N, ...}
 
 Measured config = the reference's measured config (reference train.py:18-24:
 batch 4, 3×640×960, Adam 1e-4, BCE−log-dice), single chip, bf16 compute.
 
+Honest accounting (VERDICT.md round 2 item 3):
+  * FLOPs come from XLA's own cost analysis of the compiled train step,
+    with an analytic fallback (~0.257 TFLOP forward/img, ~3× that for the
+    full step at 640×960 — per-conv 2·K²·Cin·Cout·H·W summed over the
+    UNet; the round-1 "7.3 TFLOP/img" figure was ~10× wrong).
+  * `mfu` is measured FLOP/s over the detected chip's bf16 peak.
+  * Timing excludes compile: warmup steps run (and are synced) first.
+  * Any failure still emits a parseable JSON line with an "error" field.
+
 ``vs_baseline``: the reference publishes no throughput numbers (SURVEY.md
-§6); BASELINE.md's operational target is the 2×GPU DDP config. Until a
+§6); BASELINE.md's operational target is its 2×GPU DDP config. Until a
 measured GPU number exists we normalize against an estimated 2×RTX-3090-class
-DDP throughput for this exact model/shape (≈17 imgs/sec: ~7.3 TFLOP/img
-forward+backward at ~30% utilization per GPU, README-era hardware), recorded
-here so the denominator is explicit and revisable.
+fp32 DDP throughput for this exact model/shape: ~0.77 TFLOP/img per train
+step at ~10-12 effective TFLOP/s per GPU (fp32 convs, no AMP in the
+reference) ≈ 14 imgs/s/GPU ≈ 28 imgs/s for the pair — explicit and
+revisable, recorded here so the denominator is never fabricated.
 """
 
 import json
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-# Estimated reference DDP (2 GPU) throughput for batch 4 @ 3x640x960 —
-# see module docstring; revise when a measured number lands in BASELINE.md.
-BASELINE_IMGS_PER_SEC = 17.0
+# Estimated reference DDP (2 GPU, fp32) throughput for batch 4 @ 3x640x960 —
+# derivation in the module docstring; revise when a measured number lands.
+BASELINE_IMGS_PER_SEC = 28.0
 
 BATCH = 4
 H, W = 640, 960
 WARMUP_STEPS = 3
 MEASURE_STEPS = 20
 
+# Analytic per-image FLOPs (fallback when XLA cost analysis is unavailable):
+# forward = sum of 2·K²·Cin·Cout·Hout·Wout over every conv/deconv in the
+# 4-level UNet at 640×960 ≈ 0.257 TFLOP; backward ≈ 2× forward.
+ANALYTIC_FWD_FLOPS_PER_IMG = 0.257e12
+ANALYTIC_STEP_FLOPS_PER_IMG = 3.0 * ANALYTIC_FWD_FLOPS_PER_IMG
 
-def main():
+# bf16 peak FLOP/s by TPU generation (device_kind substring, lowercase).
+PEAK_BF16_FLOPS = [
+    ("v6", 918e12),
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "tpu" in kind or device.platform == "tpu":
+        for key, peak in PEAK_BF16_FLOPS:
+            if key in kind:
+                return peak
+        return 275e12  # unknown TPU: assume v4-class
+    return 0.0  # CPU/GPU: no meaningful MFU denominator here
+
+
+def xla_step_flops(compiled) -> float:
+    """Total FLOPs per executed step per XLA's cost analysis (0 if absent)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from distributedpytorch_tpu.models.unet import UNet, init_unet_params
     from distributedpytorch_tpu.train.steps import create_train_state, make_train_step
 
     model = UNet(dtype=jnp.bfloat16)
     params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
     state, tx = create_train_state(params, 1e-4)
-    step = jax.jit(make_train_step(model, tx, batch_size=BATCH), donate_argnums=(0,))
 
     rng = np.random.default_rng(0)
     dev = jax.devices()[0]
@@ -51,29 +99,59 @@ def main():
     }
     state = jax.device_put(state, dev)
 
+    # AOT-compile once; the same executable is what we time (no hidden
+    # recompiles, and cost_analysis reads the very computation measured).
+    step_fn = make_train_step(model, tx, batch_size=BATCH)
+    compiled = (
+        jax.jit(step_fn, donate_argnums=(0,)).lower(state, batch).compile()
+    )
+    flops_per_step = xla_step_flops(compiled)
+    flops_source = "xla_cost_analysis"
+    if flops_per_step <= 0:
+        flops_per_step = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH
+        flops_source = "analytic"
+
     for _ in range(WARMUP_STEPS):
-        state, loss = step(state, batch)
+        state, loss = compiled(state, batch)
     float(loss)  # device→host transfer: a hard sync even over a PJRT relay
     # (block_until_ready alone does not force execution on tunneled devices)
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, loss = step(state, batch)
+        state, loss = compiled(state, batch)
     float(loss)  # forces the whole dependency chain of donated states
     dt = time.perf_counter() - t0
 
     imgs_per_sec = MEASURE_STEPS * BATCH / dt
-    platform = dev.platform
-    print(
-        json.dumps(
-            {
-                "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{platform}",
-                "value": round(imgs_per_sec, 2),
-                "unit": "imgs/sec",
-                "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-            }
-        )
-    )
+    achieved_flops = flops_per_step * MEASURE_STEPS / dt
+    peak = chip_peak_flops(dev)
+    return {
+        "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
+        "value": round(imgs_per_sec, 2),
+        "unit": "imgs/sec",
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "step_time_ms": round(1e3 * dt / MEASURE_STEPS, 2),
+        "flops_per_img": round(flops_per_step / BATCH / 1e9, 2),  # GFLOP
+        "flops_source": flops_source,
+        "achieved_tflops": round(achieved_flops / 1e12, 2),
+        "mfu": round(achieved_flops / peak, 4) if peak > 0 else None,
+        "device_kind": getattr(dev, "device_kind", dev.platform),
+    }
+
+
+def main():
+    try:
+        result = run()
+    except Exception as exc:  # the artifact must never be empty/unparseable
+        result = {
+            "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_error",
+            "value": 0.0,
+            "unit": "imgs/sec",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+    print(json.dumps(result))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

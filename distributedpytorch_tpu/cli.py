@@ -1,0 +1,126 @@
+"""Train UNet on images and target masks — TPU-native CLI.
+
+Installed as the ``dpt-train`` console script (pyproject.toml); ``python
+train.py`` at the repo root is the same entry point under the reference's
+launch surface.
+
+Flag-for-flag parity with the reference entry point (reference
+train.py:15-26): same short/long names, same defaults, same ``-t`` method
+names (singleGPU | DP | DDP | MP), plus the new ``DDP_MP`` hybrid and a few
+additive flags (--synthetic, --microbatches, --profile-dir, --export-pth).
+
+Launch parity (reference README.md:25-44):
+    python3 train.py                      # single device
+    python3 train.py -t DP
+    torchrun --standalone --nnodes=1 --nproc_per_node=2 train.py -t DDP -b 2
+    python3 train.py -t MP
+The torchrun path works because dist/runtime.py maps torchrun's env contract
+onto `jax.distributed.initialize` (no NCCL — XLA collectives over ICI).
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+
+def get_args():
+    parser = argparse.ArgumentParser(
+        description="Train UNet on images and target masks"
+    )
+    # reference flags (train.py:15-26)
+    parser.add_argument("--train-method", "-t", type=str, default="singleGPU",
+                        help="Training method: singleGPU | DP | DDP | MP | DDP_MP "
+                             "| SP | DDP_SP")
+    parser.add_argument("--validation", "-v", dest="val", type=float, default=10.0,
+                        help="Percentage of data used as validation")
+    parser.add_argument("--load", "-l", type=str, default=False,
+                        help="Load model from a .pth file (alias of -c, which the "
+                             "reference parsed but ignored)")
+    parser.add_argument("--epochs", "-e", type=int, default=10, help="Number of epochs")
+    parser.add_argument("--learning-rate", "--lr", type=float, default=1e-4,
+                        help="Learning rate", dest="lr")
+    parser.add_argument("--batch-size", "-b", type=int, default=4, help="Batch size")
+    parser.add_argument("--checkpoint", "-c", type=str, default=None,
+                        help="File name of the checkpoint to load")
+    parser.add_argument("--seed", "-s", type=int, default=42,
+                        help="Set seed for reproducibility")
+    # additive flags
+    parser.add_argument("--data-dir", type=str, default="./data",
+                        help="Root containing train_hq/ and train_masks/")
+    parser.add_argument("--synthetic", type=int, default=0,
+                        help="Use N in-memory synthetic samples instead of disk data")
+    parser.add_argument("--image-size", type=int, nargs=2, default=(960, 640),
+                        metavar=("W", "H"), help="Resize target (W H)")
+    parser.add_argument("--microbatches", type=int, default=2,
+                        help="Pipeline microbatches (MP/DDP_MP); reference hardcodes 2")
+    parser.add_argument("--num-workers", type=int, default=4,
+                        help="Host-side decode threads")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="Capture a jax.profiler trace here")
+    parser.add_argument("--export-pth", action="store_true",
+                        help="Also export final weights as a reference-format .pth")
+    return parser.parse_args()
+
+
+def main():
+    args = get_args()
+
+    # Multi-process init must precede any other jax call (reference
+    # train.py:58's init_process_group slot).
+    from distributedpytorch_tpu.dist import initialize_from_env, shutdown
+
+    runtime = initialize_from_env()
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.train import Trainer
+    from distributedpytorch_tpu.utils.seeding import set_seed
+
+    set_seed(args.seed)
+
+    config = TrainConfig(
+        train_method=args.train_method,
+        epochs=args.epochs,
+        learning_rate=args.lr,
+        batch_size=args.batch_size,
+        val_percent=args.val,
+        seed=args.seed,
+        data_dir=args.data_dir,
+        image_size=tuple(args.image_size),
+        num_microbatches=args.microbatches,
+        num_workers=args.num_workers,
+        checkpoint_name=args.checkpoint or (args.load if args.load else None),
+        synthetic_samples=args.synthetic,
+        profile_dir=args.profile_dir,
+    )
+
+    # logfile parity: ./logs/{method}.log, append, message-only (reference
+    # train.py:37-38) — plus stderr mirroring, rank 0 only.
+    os.makedirs(config.log_dir, exist_ok=True)
+    handlers = [
+        logging.FileHandler(
+            os.path.join(config.log_dir, f"{config.method_tag}.log"), mode="a"
+        )
+    ]
+    if runtime.is_main:
+        handlers.append(logging.StreamHandler(sys.stderr))
+    logging.basicConfig(level=logging.INFO, format="%(message)s", handlers=handlers)
+    logging.info("UNet for Carvana Image Masking (Segmentation)")
+
+    trainer = Trainer(config)
+    try:
+        result = trainer.train()
+        if args.export_pth and trainer.strategy.is_main:
+            from distributedpytorch_tpu.checkpoint import export_reference_pth
+
+            export_reference_pth(
+                trainer.state.params,
+                os.path.join(config.checkpoint_dir, f"{config.method_tag}.pth"),
+            )
+        logging.info("Done: %s", result)
+    finally:
+        shutdown()
+
+
+if __name__ == "__main__":
+    main()

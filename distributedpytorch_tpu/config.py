@@ -15,7 +15,8 @@ from typing import Optional, Tuple
 class TrainConfig:
     # -- strategy -----------------------------------------------------------
     # one of: "singleGPU" (kept for CLI parity; means single-device),
-    # "DP", "DDP", "MP", "DDP_MP" (hybrid, new capability)
+    # "DP", "DDP", "MP", "DDP_MP" (hybrid, new capability),
+    # "SP" / "DDP_SP" (spatial sharding of the image plane, new capability)
     train_method: str = "singleGPU"
 
     # -- optimization (reference train.py:18-24 defaults) -------------------
@@ -52,6 +53,15 @@ class TrainConfig:
     # -- precision ----------------------------------------------------------
     # bfloat16 keeps the MXU fed; params and loss stay float32.
     compute_dtype: str = "bfloat16"
+
+    # -- model --------------------------------------------------------------
+    # None = the reference channel plan (32,64,128,256 / mid 512, 7.76M
+    # params). Narrower tuples build faster-compiling variants for tests.
+    model_widths: Optional[Tuple[int, ...]] = None
+
+    @property
+    def model_levels(self) -> int:
+        return len(self.model_widths) if self.model_widths else 4
 
     # -- artifacts (paths mirror the reference layout, §1 layer map) --------
     checkpoint_dir: str = "./checkpoints"

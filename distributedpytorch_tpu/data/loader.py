@@ -107,8 +107,12 @@ class DataLoader:
 
     def __len__(self) -> int:
         """Batches per epoch for this shard."""
-        n = len(self.shard_spec.shard(self.indices))
+        n = self.num_samples()
         return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def num_samples(self) -> int:
+        """Samples per epoch in this process's shard (before drop_last)."""
+        return len(self.shard_spec.shard(self.indices))
 
     def steps_per_epoch(self) -> int:
         return len(self)

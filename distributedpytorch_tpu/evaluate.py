@@ -20,14 +20,24 @@ def evaluate(
     loader,
     place_batch: Callable = None,
     epoch: int = 0,
+    progress: bool = False,
 ) -> Tuple[float, float]:
     """Returns (mean val loss, mean val dice) over the loader.
 
     `eval_step(params, batch) -> {'loss', 'dice'}` is the strategy-jitted
-    step; `place_batch` moves host batches onto the mesh.
+    step; `place_batch` moves host batches onto the mesh. `progress` shows
+    the reference's per-round tqdm bar (reference evaluate.py:12).
     """
+    from tqdm import tqdm
+
     losses, dices = [], []
-    for batch in loader.epoch_batches(epoch):
+    batches = loader.epoch_batches(epoch)
+    if progress:
+        batches = tqdm(
+            batches, total=len(loader), desc="Validation round",
+            unit="batch", leave=False,
+        )
+    for batch in batches:
         if place_batch is not None:
             batch = place_batch(batch)
         metrics = eval_step(params, batch)

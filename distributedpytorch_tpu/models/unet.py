@@ -114,17 +114,27 @@ class UNet(nn.Module):
     """Full UNet: Encoder → mid ConvBlock → Decoder → 1×1 head → sigmoid
     (reference model/unet_model.py:4-11, forward at :55-61).
 
-    Input:  NHWC float, (B, H, W, 3), H and W divisible by 16.
+    Input:  NHWC float, (B, H, W, 3), H and W divisible by 2**len(widths).
     Output: (B, H, W, 1) probabilities in (0, 1).
+
+    `widths` defaults to the reference's channel plan (7,760,097 params);
+    narrower/shallower variants (e.g. ``widths=(8, 16)``) compile in a
+    fraction of the time — the test suite uses them for the parallelism
+    machinery, where the model is a payload, not the thing under test.
     """
 
     n_classes: int = 1
     dtype: Any = jnp.bfloat16
+    widths: Sequence[int] = ENCODER_WIDTHS
+    mid_width: int = 0  # 0 = 2 × widths[-1] (the reference's 256→512)
 
     def setup(self):
-        self.encoder = Encoder(dtype=self.dtype)
-        self.mid = ConvBlock(MID_WIDTH, dtype=self.dtype)
-        self.decoder = Decoder(dtype=self.dtype)
+        mid = self.mid_width or 2 * self.widths[-1]
+        self.encoder = Encoder(widths=tuple(self.widths), dtype=self.dtype)
+        self.mid = ConvBlock(mid, dtype=self.dtype)
+        self.decoder = Decoder(
+            widths=tuple(reversed(self.widths)), dtype=self.dtype
+        )
         self.segmap = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype)
 
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -153,7 +163,10 @@ def create_unet(config=None, dtype=None) -> UNet:
     """Build a UNet from a TrainConfig (or dtype override)."""
     if dtype is None:
         dtype = jnp.dtype(config.compute_dtype) if config is not None else jnp.bfloat16
-    return UNet(dtype=dtype)
+    widths = ENCODER_WIDTHS
+    if config is not None and getattr(config, "model_widths", None):
+        widths = tuple(config.model_widths)
+    return UNet(dtype=dtype, widths=widths)
 
 
 def init_unet_params(model: UNet, rng: jax.Array, input_hw=(640, 960)):

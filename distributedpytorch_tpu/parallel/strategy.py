@@ -151,33 +151,22 @@ class DataParallel(Strategy):
         return _replicate(self.mesh, state)
 
 
-class DistributedDataParallel(DataParallel):
-    """Reference ``-t DDP`` (train_utils.py:170-248): multi-process data
-    parallel, one process per host, gradient all-reduce over ICI/DCN.
+class MultiProcessMixin:
+    """The torchrun-style multi-process contract, shared by every strategy
+    with a 'data' mesh axis spanning processes (DDP, DDP_MP, DDP_SP):
 
-    Differences vs DP (exactly the reference's):
-      * the mesh spans ALL processes' devices (`jax.devices()`, global);
       * each process loads its own sample shard (`ShardSpec` = the
-        DistributedSampler, with the per-epoch reshuffle fix);
-      * config.batch_size is PER-PROCESS (global = b × world), matching the
-        torchrun launch convention (reference README.md:37);
+        DistributedSampler, reference train_utils.py:189, with the
+        per-epoch reshuffle fix);
+      * config.batch_size is PER-PROCESS (global = b × world), matching
+        the torchrun launch convention (reference README.md:37);
       * lr is scaled by the data-parallel degree when
-        ``ddp_lr_world_size_scaling`` (reference quirk 2, train_utils.py:199);
-      * eval/checkpoint/metrics on process 0 only.
+        ``ddp_lr_world_size_scaling`` (reference quirk 2,
+        train_utils.py:199);
+      * batches assemble from process-local data into one global array.
 
-    Launch: `dist/runtime.py` maps torchrun-style env vars onto
-    `jax.distributed.initialize`. Under a single process this degrades to DP
-    over all local devices — which is also how it is unit-tested on the
-    8-device virtual CPU mesh.
+    Requires `self.mesh` with a 'data' axis and `self.batch_sharding`.
     """
-
-    name = "DDP"
-
-    def __init__(self, config: TrainConfig, devices=None):
-        Strategy.__init__(self, config)
-        devs = list(devices if devices is not None else jax.devices())
-        self.mesh = Mesh(np.array(devs), ("data",))
-        self.batch_sharding = NamedSharding(self.mesh, P("data"))
 
     def data_shard(self) -> ShardSpec:
         return ShardSpec(jax.process_index(), jax.process_count())
@@ -200,6 +189,30 @@ class DistributedDataParallel(DataParallel):
             k: jax.make_array_from_process_local_data(self.batch_sharding, v)
             for k, v in batch.items()
         }
+
+
+class DistributedDataParallel(MultiProcessMixin, DataParallel):
+    """Reference ``-t DDP`` (train_utils.py:170-248): multi-process data
+    parallel, one process per host, gradient all-reduce over ICI/DCN.
+
+    Differences vs DP (exactly the reference's): the mesh spans ALL
+    processes' devices (`jax.devices()`, global); plus the
+    MultiProcessMixin contract (sample sharding, per-process batch, lr
+    scaling); eval/checkpoint/metrics on process 0 only.
+
+    Launch: `dist/runtime.py` maps torchrun-style env vars onto
+    `jax.distributed.initialize`. Under a single process this degrades to DP
+    over all local devices — which is also how it is unit-tested on the
+    8-device virtual CPU mesh.
+    """
+
+    name = "DDP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        Strategy.__init__(self, config)
+        devs = list(devices if devices is not None else jax.devices())
+        self.mesh = Mesh(np.array(devs), ("data",))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
 
 
 class Pipeline(Strategy):
@@ -277,7 +290,7 @@ class Pipeline(Strategy):
         return jax.jit(eval_step)
 
 
-class HybridDataPipeline(Pipeline):
+class HybridDataPipeline(MultiProcessMixin, Pipeline):
     """``-t DDP_MP``: data parallel × pipeline on a 2-D ('data','stage')
     mesh — the capability the reference lacks but the driver's north star
     adds (SURVEY.md §2 checklist). Batch sharded over 'data'; each data
@@ -322,28 +335,6 @@ class HybridDataPipeline(Pipeline):
     def drop_last_train(self) -> bool:
         return True
 
-    @property
-    def global_batch_size(self) -> int:
-        return self.config.batch_size * jax.process_count()
-
-    def data_shard(self) -> ShardSpec:
-        return ShardSpec(jax.process_index(), jax.process_count())
-
-    def lr_for(self, base_lr: float) -> float:
-        if self.config.ddp_lr_world_size_scaling:
-            return base_lr * self.mesh.shape["data"]
-        return base_lr
-
-    def place_batch(self, batch):
-        if jax.process_count() == 1:
-            return {
-                k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()
-            }
-        return {
-            k: jax.make_array_from_process_local_data(self.batch_sharding, v)
-            for k, v in batch.items()
-        }
-
     def _loss_fn(self, model):
         return make_pipeline_loss_fn(
             model,
@@ -372,6 +363,88 @@ class HybridDataPipeline(Pipeline):
         return jax.jit(eval_step)
 
 
+class SpatialParallel(DataParallel):
+    """``-t SP``: spatial (image-plane) sharding — the conv-net analogue of
+    sequence/context parallelism (SURVEY.md §5 marks it the natural TPU
+    extension the reference cannot express).
+
+    The image H axis is sharded over a 1-axis ('spatial',) mesh; params
+    stay replicated. Under GSPMD, XLA inserts the halo exchanges
+    (collective-permute of boundary rows) that each 3×3 conv window and
+    2×2 pool needs at shard edges — the hand-written ring exchange of a
+    CUDA implementation becomes a sharding annotation. Activation memory
+    per chip drops by the mesh size, so batch-1 images far beyond one
+    chip's HBM train without pipeline bubbles; this is how "long context"
+    looks when the sequence axis is an image plane.
+
+    Constraint: H must stay divisible by the mesh size after the 4
+    maxpools (H/16 rows at the mid level), or GSPMD pads ragged shards;
+    the constructor shrinks the mesh until it divides evenly.
+    """
+
+    name = "SP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        Strategy.__init__(self, config)
+        devs = list(devices if devices is not None else jax.local_devices())
+        h = config.image_size[1]  # image_size is (W, H), reference newsize
+        deep = 2 ** config.model_levels  # downsampling at the deepest level
+        n = len(devs)
+        while n > 1 and (h // deep) % n:
+            n -= 1
+        self.mesh = Mesh(np.array(devs[:n]), ("spatial",))
+        # image (B, H, W, C) and mask (B, H, W): shard axis 1 = H
+        self.batch_sharding = NamedSharding(self.mesh, P(None, "spatial"))
+
+    @property
+    def drop_last_train(self) -> bool:
+        return False  # batch is not sharded; ragged final batches are fine
+
+
+class HybridDataSpatial(MultiProcessMixin, SpatialParallel):
+    """``-t DDP_SP``: data × spatial on a 2-D ('data','spatial') mesh —
+    batch over 'data', image rows over 'spatial', gradients all-reduced
+    over both axes by GSPMD. The spatial sibling of DDP_MP: scale batch
+    throughput and per-image footprint at once (multi-host: 'data' maps
+    across hosts/DCN, 'spatial' stays inside the ICI domain where the
+    per-conv halo exchanges are cheap)."""
+
+    name = "DDP_SP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        Strategy.__init__(self, config)
+        devs = list(devices if devices is not None else jax.devices())
+        h = config.image_size[1]
+        deep = 2 ** config.model_levels
+        # Largest spatial degree that (a) divides the deepest level's rows
+        # and (b) still leaves a data axis ≥ 2 that divides the batch.
+        best = None
+        for sp in range(len(devs), 0, -1):
+            if (h // deep) % sp:
+                continue
+            dp = len(devs) // sp
+            while dp > 1 and config.batch_size % dp:
+                dp -= 1
+            if dp >= 2:
+                best = (dp, sp)
+                break
+        if best is None:
+            raise ValueError(
+                f"DDP_SP degenerates to plain SP: batch_size "
+                f"{config.batch_size} leaves no data axis ≥ 2 over "
+                f"{len(devs)} devices — use -t SP or raise the batch size"
+            )
+        dp, sp = best
+        self.mesh = Mesh(
+            np.array(devs[: dp * sp]).reshape(dp, sp), ("data", "spatial")
+        )
+        self.batch_sharding = NamedSharding(self.mesh, P("data", "spatial"))
+
+    @property
+    def drop_last_train(self) -> bool:
+        return True
+
+
 STRATEGIES = {
     cls.name: cls
     for cls in (
@@ -380,6 +453,8 @@ STRATEGIES = {
         DistributedDataParallel,
         Pipeline,
         HybridDataPipeline,
+        SpatialParallel,
+        HybridDataSpatial,
     )
 }
 

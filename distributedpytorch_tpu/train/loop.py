@@ -97,9 +97,12 @@ class Trainer:
             shard=self.strategy.data_shard(),
             num_workers=config.num_workers,
         )
-        # Val: unsharded, drop_last=True (reference train_utils.py:42), run
-        # by the main process only (reference :235-241) — but through the
-        # strategy's mesh so pipeline eval stays pipelined.
+        # Val: unsharded, drop_last=True (reference train_utils.py:42).
+        # Deliberate divergence from the reference's rank-0-only eval
+        # (reference :235-241): EVERY process evaluates the same unsharded
+        # val set, so the plateau scheduler sees identical val losses
+        # everywhere and per-rank lr divergence (reference quirk 7) cannot
+        # happen. Redundant work, bought for determinism.
         self.val_loader = DataLoader(
             self.dataset,
             indices=val_idx,
@@ -181,7 +184,7 @@ class Trainer:
     # ------------------------------------------------------------------
     def train(self) -> dict:
         cfg = self.config
-        n_train = len(self.train_loader) * cfg.batch_size
+        n_train = self.train_loader.num_samples()
         logger.info(
             "Training %s: %d epochs, global batch %d, lr %.2e, %d train batches/shard",
             cfg.train_method,
@@ -193,24 +196,43 @@ class Trainer:
         if cfg.profile_dir and self.strategy.is_main:
             jax.profiler.start_trace(cfg.profile_dir)
 
+        from tqdm import tqdm
+
         global_step = int(self.state.step)
         val_loss = float("nan")
         val_dice = float("nan")
         for epoch in range(self.start_epoch, cfg.epochs):
-            for batch in self.train_loader.epoch_batches(epoch):
-                n_imgs = batch["image"].shape[0]
-                placed = self.strategy.place_batch(batch)
-                self.state, loss = self.train_step(self.state, placed)
-                global_step += 1
-                # loss stays a device scalar; LossRecords syncs it to host
-                # only when a 10-step metrics row is due
-                self.records.record_train(global_step, loss, n_imgs)
+            # tqdm parity (reference train_utils.py:57): per-epoch image bar,
+            # main process only. Postfix shows the mean-of-last-10 row loss —
+            # NOT the per-step loss, which would force a device sync per step.
+            # exact images this epoch will yield: drop_last trims the ragged
+            # tail, otherwise every shard sample appears exactly once
+            with tqdm(
+                total=min(n_train, len(self.train_loader) * cfg.batch_size),
+                desc=f"Epoch {epoch + 1}/{cfg.epochs}",
+                unit="img",
+                disable=not self.strategy.is_main,
+                leave=False,
+            ) as pbar:
+                for batch in self.train_loader.epoch_batches(epoch):
+                    n_imgs = batch["image"].shape[0]
+                    placed = self.strategy.place_batch(batch)
+                    self.state, loss = self.train_step(self.state, placed)
+                    global_step += 1
+                    # loss stays a device scalar; LossRecords syncs it to host
+                    # only when a 10-step metrics row is due
+                    rows_before = len(self.records.train_rows)
+                    self.records.record_train(global_step, loss, n_imgs)
+                    pbar.update(n_imgs)
+                    if len(self.records.train_rows) > rows_before:
+                        pbar.set_postfix(loss=f"{self.records.train_rows[-1][2]:.4f}")
 
             val_loss, val_dice = evaluate(
                 self.eval_step,
                 self.state.params,
                 self.val_loader,
                 self.strategy.place_batch,
+                progress=self.strategy.is_main,
             )
             self.records.record_val(global_step, val_loss, val_dice)
             new_lr = self.scheduler.step(val_loss)

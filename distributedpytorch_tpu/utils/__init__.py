@@ -1,1 +1,2 @@
+from distributedpytorch_tpu.utils.plotting import plot_img_and_mask  # noqa: F401
 from distributedpytorch_tpu.utils.seeding import set_seed  # noqa: F401

@@ -42,6 +42,7 @@ def main():
         seed=42,
         compute_dtype="float32",
         image_size=(48, 32),
+        model_widths=(8, 16),  # tiny model: this tests the runtime, not UNet
         synthetic_samples=32,
         checkpoint_dir=os.path.join(out_dir, "checkpoints"),
         log_dir=os.path.join(out_dir, "logs"),

@@ -22,6 +22,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_ddp(tmp_path):
     port = _free_port()
     procs = []

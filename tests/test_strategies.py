@@ -20,15 +20,20 @@ from distributedpytorch_tpu.parallel.pipeline import (
 )
 from distributedpytorch_tpu.train.steps import create_train_state, make_train_step
 
-# Small shapes: H,W divisible by 16; float32 compute for exact comparisons.
-# B=8 covers every strategy on the 8-device mesh (hybrid needs
-# data_shards(4) × microbatches(2) = 8).
+# Small shapes; float32 compute for exact comparisons. B=8 covers every
+# strategy on the 8-device mesh (hybrid needs data_shards(4) ×
+# microbatches(2) = 8). The model under test is a 2-level narrow UNet
+# (WIDTHS): these tests exercise the parallelism machinery, where the model
+# is a payload — the reference-sized model's own goldens live in
+# test_model.py, and compiling 7.76M-param graphs ~20 times here was most
+# of the old suite's 13-minute wall time.
 H, W, B = 32, 48, 8
+WIDTHS = (8, 16)
 
 
 @pytest.fixture(scope="module")
 def model():
-    return UNet(dtype=jnp.float32)
+    return UNet(dtype=jnp.float32, widths=WIDTHS)
 
 
 @pytest.fixture(scope="module")
@@ -74,27 +79,26 @@ def _config(method, **kw):
         batch_size=B,
         compute_dtype="float32",
         image_size=(W, H),
+        model_widths=WIDTHS,
         **kw,
     )
 
 
 class TestPipelineNumerics:
-    def test_pipeline_loss_matches_plain(self, model, params, batch):
+    def test_pipeline_loss_and_grads_match_plain(self, model, params, batch):
+        """Loss AND grads in one value_and_grad — one XLA compile covers
+        both equivalence claims (separate tests each paid the full compile
+        of the pipelined backward, the old suite's single slowest item)."""
         cfg = _config("MP")
         strat = build_strategy(cfg)
         loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
-        ref_loss, _ = _ref_loss_and_grads(model, params, batch)
-        pipe_loss = loss_fn(params, _prep(batch))
+        ref_loss, ref_grads = _ref_loss_and_grads(model, params, batch)
+        pipe_loss, pipe_grads = jax.value_and_grad(
+            lambda p: loss_fn(p, _prep(batch))
+        )(params)
         np.testing.assert_allclose(
             float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6
         )
-
-    def test_pipeline_grads_match_plain(self, model, params, batch):
-        cfg = _config("MP")
-        strat = build_strategy(cfg)
-        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
-        _, ref_grads = _ref_loss_and_grads(model, params, batch)
-        pipe_grads = jax.grad(lambda p: loss_fn(p, _prep(batch)))(params)
         _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
 
     def test_pipeline_forward_matches_plain(self, model, params, batch):
@@ -151,7 +155,7 @@ class TestStrategySteps:
         strat = build_strategy(cfg)
         return self._stepped_params(strat, model, params, batch, cfg)
 
-    @pytest.mark.parametrize("method", ["DP", "DDP", "MP", "DDP_MP"])
+    @pytest.mark.parametrize("method", ["DP", "DDP", "MP", "DDP_MP", "SP", "DDP_SP"])
     def test_step_matches_single(self, method, model, params, batch, single_result):
         cfg = _config(method, ddp_lr_world_size_scaling=False)
         strat = build_strategy(cfg)
@@ -172,6 +176,37 @@ class TestStrategySteps:
         assert strat.lr_for(1e-4) == pytest.approx(1e-4 * 8)
         cfg2 = _config("DDP", ddp_lr_world_size_scaling=False)
         assert build_strategy(cfg2).lr_for(1e-4) == pytest.approx(1e-4)
+
+    def test_spatial_sharding_shapes(self, batch):
+        """SP shards the H axis; DDP_SP shards batch × H on a 2-D mesh.
+        2-level model → deep rows = (H=32)/4 = 8 → full 8-way spatial."""
+        strat = build_strategy(_config("SP"))
+        assert dict(strat.mesh.shape) == {"spatial": 8}
+        placed = strat.place_batch(batch)
+        shard = next(iter(placed["image"].addressable_shards))
+        assert shard.data.shape == (B, H // 8, W, 3)
+
+        strat2 = build_strategy(_config("DDP_SP"))
+        assert dict(strat2.mesh.shape) == {"data": 2, "spatial": 4}
+        placed2 = strat2.place_batch(batch)
+        shard2 = next(iter(placed2["image"].addressable_shards))
+        assert shard2.data.shape == (B // 2, H // 4, W, 3)
+
+    def test_spatial_with_reference_depth_model(self, batch):
+        """4-level default model at H=32: only 2 deep rows → the SP mesh
+        shrinks to 2 and the hybrid becomes data 4 × spatial 2."""
+        cfg = TrainConfig(
+            train_method="SP", batch_size=B, compute_dtype="float32",
+            image_size=(W, H),
+        )
+        assert dict(build_strategy(cfg).mesh.shape) == {"spatial": 2}
+        cfg2 = TrainConfig(
+            train_method="DDP_SP", batch_size=B, compute_dtype="float32",
+            image_size=(W, H),
+        )
+        assert dict(build_strategy(cfg2).mesh.shape) == {
+            "data": 4, "spatial": 2,
+        }
 
     def test_unknown_method_raises(self):
         with pytest.raises(ValueError, match="Unknown train method"):
