@@ -56,6 +56,12 @@ def get_args():
                         help="Pipeline microbatches (MP/DDP_MP); reference hardcodes 2")
     parser.add_argument("--num-workers", type=int, default=4,
                         help="Host-side decode threads")
+    parser.add_argument("--steps-per-dispatch", type=int, default=1,
+                        help="Optimizer steps fused into one XLA dispatch "
+                             "(amortizes runtime dispatch latency)")
+    parser.add_argument("--remat", action="store_true",
+                        help="Rematerialize activations in the backward "
+                             "(~half HBM, ~1/3 more FLOPs)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Capture a jax.profiler trace here")
     parser.add_argument("--export-pth", action="store_true",
@@ -89,6 +95,8 @@ def main():
         image_size=tuple(args.image_size),
         num_microbatches=args.microbatches,
         num_workers=args.num_workers,
+        steps_per_dispatch=args.steps_per_dispatch,
+        remat=args.remat,
         checkpoint_name=args.checkpoint or (args.load if args.load else None),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,

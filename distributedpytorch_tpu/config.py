@@ -75,6 +75,19 @@ class TrainConfig:
     # -- synthetic data (tests / benches without the Carvana download) ------
     synthetic_samples: int = 0  # >0: use an in-memory procedural dataset
 
+    # -- memory -------------------------------------------------------------
+    # Rematerialize the forward during backward (jax.checkpoint): ~half the
+    # activation HBM for ~1/3 more FLOPs. Off by default (HBM is ample at
+    # the reference config); turn on for big batches / high resolutions.
+    remat: bool = False
+
+    # -- dispatch amortization ----------------------------------------------
+    # K optimizer steps per XLA dispatch (lax.scan over K stacked batches).
+    # Semantically identical to K single steps on the same data; amortizes
+    # per-dispatch runtime latency, which dominates step time on remote /
+    # tunneled TPU runtimes. 1 = one dispatch per step (reference-shaped).
+    steps_per_dispatch: int = 1
+
     # -- observability ------------------------------------------------------
     metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)
     profile_dir: Optional[str] = None  # jax.profiler trace capture when set

@@ -30,6 +30,7 @@ from distributedpytorch_tpu.parallel.pipeline import (
 from distributedpytorch_tpu.train.steps import (
     TrainState,
     make_eval_step,
+    make_multi_train_step,
     make_train_step,
 )
 
@@ -86,17 +87,47 @@ class Strategy:
         return jax.device_put(state, dev)
 
     # -- compiled steps -----------------------------------------------------
-    def build_train_step(self, model, tx) -> Callable:
+    def _raw_step(self, model, tx) -> Callable:
+        """The unjitted per-batch step this strategy runs (overridden by
+        pipeline strategies, which schedule stages inside the step)."""
         # Quirk-1 scale uses the PER-PROCESS batch_size (the reference's `-b`
         # value): fit_DDP scales by its local -b then mean-allreduces, so the
         # global batch would overscale by world_size.
-        step = make_train_step(
+        return make_train_step(
             model,
             tx,
             batch_size=self.config.batch_size,
             faithful_loss_scaling=self.config.faithful_loss_scaling,
+            remat=self.config.remat,
         )
-        return jax.jit(step, donate_argnums=(0,))
+
+    def build_train_step(self, model, tx) -> Callable:
+        return jax.jit(self._raw_step(model, tx), donate_argnums=(0,))
+
+    def build_multi_train_step(self, model, tx) -> Callable:
+        """K steps per dispatch: `multi(state, stacked) -> (state, losses)`
+        with batches stacked on a leading axis (see make_multi_train_step;
+        place the stacked batch with `place_stacked_batch`)."""
+        multi = make_multi_train_step(self._raw_step(model, tx))
+        return jax.jit(multi, donate_argnums=(0,))
+
+    def place_stacked_batch(
+        self, stacked: Dict[str, np.ndarray]
+    ) -> Dict[str, jax.Array]:
+        """Place a (K, B, ...) stack of K per-step batches; the K axis is
+        never sharded (it is scanned over), each step's batch keeps this
+        strategy's per-batch sharding."""
+        if self.mesh is None:
+            dev = jax.devices()[0]
+            return {k: jax.device_put(v, dev) for k, v in stacked.items()}
+        sharding = self._stacked_sharding()
+        return {k: jax.device_put(v, sharding) for k, v in stacked.items()}
+
+    def _stacked_sharding(self) -> NamedSharding:
+        """`batch_sharding` shifted right by the leading K axis."""
+        return NamedSharding(
+            self.mesh, P(None, *tuple(self.batch_sharding.spec))
+        )
 
     def build_eval_step(self, model) -> Callable:
         return jax.jit(make_eval_step(model))
@@ -190,6 +221,15 @@ class MultiProcessMixin:
             for k, v in batch.items()
         }
 
+    def place_stacked_batch(self, stacked):
+        sharding = self._stacked_sharding()
+        if jax.process_count() == 1:
+            return {k: jax.device_put(v, sharding) for k, v in stacked.items()}
+        return {
+            k: jax.make_array_from_process_local_data(sharding, v)
+            for k, v in stacked.items()
+        }
+
 
 class DistributedDataParallel(MultiProcessMixin, DataParallel):
     """Reference ``-t DDP`` (train_utils.py:170-248): multi-process data
@@ -244,11 +284,12 @@ class Pipeline(Strategy):
             self.mesh,
             num_microbatches=self.config.num_microbatches,
             data_axis=None,
+            remat=self.config.remat,
         )
 
-    def build_train_step(self, model, tx) -> Callable:
+    def _raw_step(self, model, tx) -> Callable:
         pipeline_loss = self._loss_fn(model)
-        # per-process batch, same rationale as Strategy.build_train_step
+        # per-process batch, same rationale as Strategy._raw_step
         grad_scale = (
             float(self.config.batch_size)
             if self.config.faithful_loss_scaling
@@ -269,7 +310,7 @@ class Pipeline(Strategy):
                 loss,
             )
 
-        return jax.jit(step, donate_argnums=(0,))
+        return step
 
     def build_eval_step(self, model) -> Callable:
         # Eval runs the pipelined forward too (the reference evaluates
@@ -341,6 +382,7 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
             self.mesh,
             num_microbatches=self.config.num_microbatches,
             data_axis="data",
+            remat=self.config.remat,
         )
 
     def build_eval_step(self, model) -> Callable:

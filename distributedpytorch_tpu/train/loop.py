@@ -113,6 +113,14 @@ class Trainer:
         )
 
         self.train_step = self.strategy.build_train_step(self.model, self.tx)
+        # K>1: fuse K optimizer steps into one dispatch (lax.scan); the
+        # single-step path still handles the ragged tail of each epoch.
+        self.k_dispatch = max(1, int(config.steps_per_dispatch))
+        self.multi_step = (
+            self.strategy.build_multi_train_step(self.model, self.tx)
+            if self.k_dispatch > 1
+            else None
+        )
         self.eval_step = self.strategy.build_eval_step(self.model)
         self.records = LossRecords(
             config.method_tag, config.loss_dir, every=config.metric_every_steps
@@ -182,6 +190,13 @@ class Trainer:
         )
 
     # ------------------------------------------------------------------
+    def _record(self, loss, n_imgs: int, global_step: int, pbar) -> None:
+        rows_before = len(self.records.train_rows)
+        self.records.record_train(global_step, loss, n_imgs)
+        pbar.update(n_imgs)
+        if len(self.records.train_rows) > rows_before:
+            pbar.set_postfix(loss=f"{self.records.train_rows[-1][2]:.4f}")
+
     def train(self) -> dict:
         cfg = self.config
         n_train = self.train_loader.num_samples()
@@ -214,18 +229,61 @@ class Trainer:
                 disable=not self.strategy.is_main,
                 leave=False,
             ) as pbar:
-                for batch in self.train_loader.epoch_batches(epoch):
+                def run_one(batch):
+                    nonlocal global_step
                     n_imgs = batch["image"].shape[0]
                     placed = self.strategy.place_batch(batch)
                     self.state, loss = self.train_step(self.state, placed)
                     global_step += 1
                     # loss stays a device scalar; LossRecords syncs it to host
                     # only when a 10-step metrics row is due
-                    rows_before = len(self.records.train_rows)
-                    self.records.record_train(global_step, loss, n_imgs)
-                    pbar.update(n_imgs)
-                    if len(self.records.train_rows) > rows_before:
-                        pbar.set_postfix(loss=f"{self.records.train_rows[-1][2]:.4f}")
+                    self._record(loss, n_imgs, global_step, pbar)
+
+                def run_stack(buffered):
+                    nonlocal global_step
+                    stacked = {
+                        key: np.stack([b[key] for b in buffered])
+                        for key in buffered[0]
+                    }
+                    placed = self.strategy.place_stacked_batch(stacked)
+                    self.state, losses = self.multi_step(self.state, placed)
+                    # ONE memoized device→host pull for the whole (K,) loss
+                    # array, and only when a metrics row actually needs it —
+                    # slicing losses[i] here would issue K extra dispatches
+                    # and forfeit the amortization this path exists for.
+                    memo = {}
+
+                    def lazy(i):
+                        def pull():
+                            if "host" not in memo:
+                                memo["host"] = np.asarray(losses)
+                            return memo["host"][i]
+
+                        return pull
+
+                    for i, b in enumerate(buffered):
+                        global_step += 1
+                        self._record(lazy(i), b["image"].shape[0], global_step, pbar)
+
+                buffer = []
+                for batch in self.train_loader.epoch_batches(epoch):
+                    if self.multi_step is None:
+                        run_one(batch)
+                        continue
+                    # only full, uniformly-shaped batches can stack into the
+                    # scanned executable; the tail falls through to run_one
+                    if batch["image"].shape[0] == cfg.batch_size:
+                        buffer.append(batch)
+                        if len(buffer) == self.k_dispatch:
+                            run_stack(buffer)
+                            buffer = []
+                    else:
+                        for b in buffer:
+                            run_one(b)
+                        buffer = []
+                        run_one(batch)
+                for b in buffer:
+                    run_one(b)
 
             val_loss, val_dice = evaluate(
                 self.eval_step,

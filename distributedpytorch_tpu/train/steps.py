@@ -69,15 +69,23 @@ def make_train_step(
     tx: optax.GradientTransformation,
     batch_size: int,
     faithful_loss_scaling: bool = True,
+    remat: bool = False,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
     """Build the (unjitted) train step; the strategy decides how to jit/shard
-    it. Returns ``step(state, batch) -> (state, unscaled_loss)``."""
+    it. Returns ``step(state, batch) -> (state, unscaled_loss)``.
+
+    `remat=True` rematerializes the forward during the backward
+    (jax.checkpoint): activations are recomputed instead of stored, cutting
+    peak HBM roughly in half for ~1/3 more FLOPs — the TPU-native answer to
+    the reference's 7.8 GB-at-batch-4 VRAM wall (modelsummary.txt:72).
+    """
 
     grad_scale = float(batch_size) if faithful_loss_scaling else 1.0
+    fwd = jax.checkpoint(loss_fn, static_argnums=(0,)) if remat else loss_fn
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
         loss, grads = jax.value_and_grad(
-            lambda p: loss_fn(model, p, batch)
+            lambda p: fwd(model, p, batch)
         )(state.params)
         if grad_scale != 1.0:
             # (batch_size * loss).backward() parity, reference train_utils.py:69
@@ -90,6 +98,26 @@ def make_train_step(
         )
 
     return train_step
+
+
+def make_multi_train_step(
+    step: Callable,
+) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
+    """Scan `step` over a leading steps axis in ONE XLA executable.
+
+    ``batches`` is the per-step batch stacked to ``{'image': (K,B,H,W,3),
+    'mask': (K,B,H,W)}``; returns ``(state, losses (K,))``. Semantically
+    identical to K separate `step` calls on the same data, but the runtime
+    dispatches once per K steps instead of once per step — on a remote or
+    tunneled PJRT runtime per-dispatch latency otherwise dominates the step
+    time (measured: ~50 ms/dispatch over this image's TPU relay, >10× the
+    chip's compute time for the reference config).
+    """
+
+    def multi_step(state: TrainState, batches: Dict[str, jax.Array]):
+        return jax.lax.scan(step, state, batches)
+
+    return multi_step
 
 
 def make_eval_step(model) -> Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]:

@@ -46,8 +46,11 @@ class LossRecords:
         """Call once per optimizer step with the UNSCALED loss
         (reference train_utils.py:67, 75-79).
 
-        `loss` may be a device scalar: it is kept unforced and converted to
-        host floats only when a metrics row is due, so the train loop stays
+        `loss` may be a device scalar OR a zero-arg callable returning one
+        (the multi-step path defers slicing its (K,) loss array until a row
+        is due — slicing eagerly would issue K extra device dispatches and
+        undo the dispatch amortization). Either way nothing is forced to
+        host until a metrics row is due, so the train loop stays
         dispatch-async between rows (one host sync per `every` steps)."""
         self.losses.append(loss)
         self.images_seen += batch_images
@@ -57,7 +60,7 @@ class LossRecords:
             self._steady_t0 = time.time()
             self._steady_images0 = self.images_seen
         if step % self.every == 0:
-            window = [float(x) for x in self.losses[-self.every :]]
+            window = [float(x() if callable(x) else x) for x in self.losses[-self.every :]]
             self.losses[-self.every :] = window
             self.train_rows.append([step, time.time() - self.start_time, float(np.mean(window))])
 

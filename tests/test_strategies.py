@@ -208,6 +208,16 @@ class TestStrategySteps:
             "data": 4, "spatial": 2,
         }
 
+    def test_remat_matches_plain(self, model, params, batch, single_result):
+        """jax.checkpoint rematerialization must be numerics-neutral: same
+        loss, same post-step params as the plain single-device step."""
+        cfg = _config("singleGPU", remat=True)
+        strat = build_strategy(cfg)
+        got_params, got_loss = self._stepped_params(strat, model, params, batch, cfg)
+        ref_params, ref_loss = single_result
+        np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-6, atol=1e-7)
+        _tree_allclose(ref_params, got_params, rtol=1e-5, atol=1e-6)
+
     def test_unknown_method_raises(self):
         with pytest.raises(ValueError, match="Unknown train method"):
             build_strategy(_config("FSDP9000"))
