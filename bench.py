@@ -26,6 +26,7 @@ revisable, recorded here so the denominator is never fabricated.
 """
 
 import json
+import os
 import sys
 import time
 
@@ -33,10 +34,15 @@ import time
 # derivation in the module docstring; revise when a measured number lands.
 BASELINE_IMGS_PER_SEC = 28.0
 
-BATCH = 4
-H, W = 640, 960
+BATCH = int(os.environ.get("BENCH_BATCH", 4))
+H = int(os.environ.get("BENCH_H", 640))
+W = int(os.environ.get("BENCH_W", 960))
 WARMUP_STEPS = 3
-MEASURE_STEPS = 20
+MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 20))
+# Steps fused per dispatch for the headline number (the trainer's
+# --steps-per-dispatch path): on a remote/tunneled PJRT runtime per-dispatch
+# latency (~50 ms measured here) otherwise dominates the ~chip-time step.
+FUSED_STEPS = 10
 
 # Analytic per-image FLOPs (fallback when XLA cost analysis is unavailable):
 # forward = sum of 2·K²·Cin·Cout·Hout·Wout over every conv/deconv in the
@@ -83,7 +89,11 @@ def run() -> dict:
     import numpy as np
 
     from distributedpytorch_tpu.models.unet import UNet, init_unet_params
-    from distributedpytorch_tpu.train.steps import create_train_state, make_train_step
+    from distributedpytorch_tpu.train.steps import (
+        create_train_state,
+        make_multi_train_step,
+        make_train_step,
+    )
 
     model = UNet(dtype=jnp.bfloat16)
     params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
@@ -97,13 +107,24 @@ def run() -> dict:
             (rng.random((BATCH, H, W)) > 0.5).astype(np.int32), dev
         ),
     }
+    # the fused executable scans over K stacked (identical) batches — what
+    # the trainer dispatches under --steps-per-dispatch K
+    stacked = {
+        k: jax.device_put(jnp.broadcast_to(v, (FUSED_STEPS,) + v.shape), dev)
+        for k, v in batch.items()
+    }
     state = jax.device_put(state, dev)
 
-    # AOT-compile once; the same executable is what we time (no hidden
+    # AOT-compile once; the same executables are what we time (no hidden
     # recompiles, and cost_analysis reads the very computation measured).
     step_fn = make_train_step(model, tx, batch_size=BATCH)
     compiled = (
         jax.jit(step_fn, donate_argnums=(0,)).lower(state, batch).compile()
+    )
+    multi = (
+        jax.jit(make_multi_train_step(step_fn), donate_argnums=(0,))
+        .lower(state, stacked)
+        .compile()
     )
     flops_per_step = xla_step_flops(compiled)
     flops_source = "xla_cost_analysis"
@@ -111,6 +132,7 @@ def run() -> dict:
         flops_per_step = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH
         flops_source = "analytic"
 
+    # -- unfused: one dispatch per step --------------------------------------
     for _ in range(WARMUP_STEPS):
         state, loss = compiled(state, batch)
     float(loss)  # device→host transfer: a hard sync even over a PJRT relay
@@ -120,17 +142,37 @@ def run() -> dict:
     for _ in range(MEASURE_STEPS):
         state, loss = compiled(state, batch)
     float(loss)  # forces the whole dependency chain of donated states
-    dt = time.perf_counter() - t0
+    dt_unfused = time.perf_counter() - t0
+    unfused_per_step = dt_unfused / MEASURE_STEPS
 
-    imgs_per_sec = MEASURE_STEPS * BATCH / dt
-    achieved_flops = flops_per_step * MEASURE_STEPS / dt
+    # -- fused: K steps per dispatch (headline) ------------------------------
+    # symmetric methodology on a per-STEP basis: one warmup dispatch already
+    # runs FUSED_STEPS (=10) warmup steps vs the unfused path's 3, and the
+    # measured window is ≥3 dispatches / ≥30 steps vs the unfused 20 — so
+    # min() below compares like with like instead of letting one lucky
+    # 2-dispatch window pick the headline
+    state, losses = multi(state, stacked)
+    float(losses[-1])
+    reps = max(3, MEASURE_STEPS // FUSED_STEPS)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, losses = multi(state, stacked)
+    float(losses[-1])
+    dt_fused = time.perf_counter() - t0
+    fused_per_step = dt_fused / (reps * FUSED_STEPS)
+
+    per_step = min(fused_per_step, unfused_per_step)
+    imgs_per_sec = BATCH / per_step
+    achieved_flops = flops_per_step / per_step
     peak = chip_peak_flops(dev)
     return {
         "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
         "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-        "step_time_ms": round(1e3 * dt / MEASURE_STEPS, 2),
+        "step_time_ms": round(1e3 * per_step, 2),
+        "steps_per_dispatch": FUSED_STEPS if per_step == fused_per_step else 1,
+        "imgs_per_sec_single_dispatch": round(BATCH / unfused_per_step, 2),
         "flops_per_img": round(flops_per_step / BATCH / 1e9, 2),  # GFLOP
         "flops_source": flops_source,
         "achieved_tflops": round(achieved_flops / 1e12, 2),

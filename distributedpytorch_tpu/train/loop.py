@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import time
 from typing import Optional
 
@@ -197,7 +198,64 @@ class Trainer:
         if len(self.records.train_rows) > rows_before:
             pbar.set_postfix(loss=f"{self.records.train_rows[-1][2]:.4f}")
 
+    def _install_signal_handler(self):
+        """Failure detection the reference lacks (SURVEY.md §5: a mid-run
+        crash loses everything): on SIGTERM/SIGINT, finish the in-flight
+        step, checkpoint full state, then exit — so preemption (the normal
+        way TPU jobs die) costs at most one epoch of progress, resumable
+        via ``-c <method>``.
+
+        Signal handlers are main-thread-only; if train() runs on another
+        thread the install fails and this feature is simply OFF (signals
+        then take their default action — no graceful checkpoint).
+
+        Multi-process runs stop only at epoch boundaries, and only by
+        AGREEMENT (`_stop_agreed` allgathers the flag): a rank that broke
+        out mid-epoch on a local signal would abandon the collectives its
+        peers' jitted steps are waiting on and hang the job.
+        """
+        self._stop_requested = False
+        self._prev_handlers = {}
+
+        def request_stop(signum, frame):
+            self._stop_requested = True
+            logger.info(
+                "Signal %d: will checkpoint and stop at the next step", signum
+            )
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(sig, request_stop)
+            except ValueError:  # not in main thread — feature unavailable
+                pass
+
+    def _restore_signal_handler(self):
+        for sig, handler in self._prev_handlers.items():
+            signal.signal(sig, handler)
+
+    def _stop_agreed(self) -> bool:
+        """Collective stop decision: True iff ANY process saw a signal.
+        One tiny allgather per epoch — never called per step."""
+        if jax.process_count() == 1:
+            return self._stop_requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if self._stop_requested else 0], np.int32)
+        )
+        return bool(np.any(flags))
+
     def train(self) -> dict:
+        """Run the configured epochs; signal handlers are scoped to the run
+        (try/finally: an exception mid-epoch must not leave the process
+        uninterruptible)."""
+        self._install_signal_handler()
+        try:
+            return self._run()
+        finally:
+            self._restore_signal_handler()
+
+    def _run(self) -> dict:
         cfg = self.config
         n_train = self.train_loader.num_samples()
         logger.info(
@@ -266,7 +324,13 @@ class Trainer:
                         self._record(lazy(i), b["image"].shape[0], global_step, pbar)
 
                 buffer = []
+                single_process = jax.process_count() == 1
                 for batch in self.train_loader.epoch_batches(epoch):
+                    # mid-epoch stop is single-process only: in multi-process
+                    # runs ranks must agree (epoch boundary) or collectives
+                    # desync and hang — see _install_signal_handler
+                    if self._stop_requested and single_process:
+                        break
                     if self.multi_step is None:
                         run_one(batch)
                         continue
@@ -283,7 +347,26 @@ class Trainer:
                         buffer = []
                         run_one(batch)
                 for b in buffer:
+                    # never train buffered batches past a stop request: they
+                    # were never stepped, so skipping them loses nothing, and
+                    # a preemption grace window may be ticking
+                    if self._stop_requested and single_process:
+                        break
                     run_one(b)
+
+            if self._stop_agreed():
+                # save a resumable snapshot at the last COMPLETED epoch —
+                # resume redoes the interrupted epoch from its start (the
+                # dedup guard is cleared: mid-epoch params/opt state are
+                # newer than the end-of-previous-epoch save of same index)
+                self._last_saved_epoch = None
+                self._save(epoch)
+                logger.info(
+                    "Stopped by signal at epoch %d step %d; checkpoint saved",
+                    epoch + 1,
+                    global_step,
+                )
+                break
 
             val_loss, val_dice = evaluate(
                 self.eval_step,
@@ -316,7 +399,8 @@ class Trainer:
         if cfg.profile_dir and self.strategy.is_main:
             jax.profiler.stop_trace()
 
-        self._save(cfg.epochs)
+        if not self._stop_requested:
+            self._save(cfg.epochs)
         if self.strategy.is_main:
             self.records.save()
         return {

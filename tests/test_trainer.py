@@ -138,6 +138,34 @@ def test_steps_per_dispatch_sharded(method, tmp_path):
     _compare_k_dispatch(tmp_path, method, epochs=1)
 
 
+def test_signal_checkpoints_and_stops(tmp_path):
+    """SIGTERM mid-run → full-state checkpoint lands and training exits
+    cleanly (failure detection the reference lacks, SURVEY.md §5); the
+    checkpoint resumes."""
+    import signal
+
+    cfg = _config(tmp_path, epochs=50)  # long run we will interrupt
+    trainer = Trainer(cfg)
+    orig = trainer._record
+
+    fired = {}
+
+    def record_then_signal(*a, **kw):
+        orig(*a, **kw)
+        if not fired:
+            fired["x"] = True
+            signal.raise_signal(signal.SIGTERM)
+
+    trainer._record = record_then_signal
+    result = trainer.train()
+    assert result["steps"] < 50 * 3  # stopped early
+    assert os.path.exists(tmp_path / "checkpoints" / "singleGPU.ckpt")
+    resumed = Trainer(_config(tmp_path, epochs=50, checkpoint_name="singleGPU"))
+    assert resumed.start_epoch == 0  # interrupted epoch will be redone
+    # default handler restored
+    assert signal.getsignal(signal.SIGTERM) == signal.SIG_DFL
+
+
 @pytest.mark.slow
 def test_strategies_agree_on_first_losses(tmp_path):
     """The same seeded data + init under different strategies must produce
