@@ -62,6 +62,8 @@ def get_args():
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize activations in the backward "
                              "(~half HBM, ~1/3 more FLOPs)")
+    parser.add_argument("--pallas", action="store_true",
+                        help="Use the fused Pallas loss-stats kernel for eval")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Capture a jax.profiler trace here")
     parser.add_argument("--export-pth", action="store_true",
@@ -97,6 +99,7 @@ def main():
         num_workers=args.num_workers,
         steps_per_dispatch=args.steps_per_dispatch,
         remat=args.remat,
+        use_pallas=args.pallas,
         checkpoint_name=args.checkpoint or (args.load if args.load else None),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,

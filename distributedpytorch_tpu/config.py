@@ -81,6 +81,14 @@ class TrainConfig:
     # the reference config); turn on for big batches / high resolutions.
     remat: bool = False
 
+    # -- kernels ------------------------------------------------------------
+    # Route the eval loss through the fused Pallas stats kernel
+    # (ops/pallas_kernels.py). Numerics-identical to the XLA path; takes
+    # effect only on strategies whose eval batch is unsharded (singleGPU —
+    # pallas_call has no GSPMD partition rule); sharded strategies warn and
+    # keep the XLA loss. Off by default.
+    use_pallas: bool = False
+
     # -- dispatch amortization ----------------------------------------------
     # K optimizer steps per XLA dispatch (lax.scan over K stacked batches).
     # Semantically identical to K single steps on the same data; amortizes

@@ -1,0 +1,139 @@
+"""Pallas TPU kernels for the framework's hot elementwise+reduction ops.
+
+The compute path of this framework is XLA-compiled convolutions (XLA's conv
+lowering owns the MXU; hand-writing convs would fight the compiler, see
+SURVEY.md §7 hard-part 4). What Pallas is the right tool for here is the
+fused tail op: the BCE + soft-dice sufficient statistics over the full
+(B, H, W, 1) probability map — four reductions plus elementwise logs that
+XLA schedules as separate fusions. `bce_dice_stats_pallas` computes all
+four in ONE pass over the data: each (block, 128-lane) tile is read from
+VMEM once, the clamped-log BCE term and the dice partial sums are computed
+in registers, and four scalar accumulators in SMEM carry the running sums
+across the sequential grid (the standard Pallas reduction pattern:
+initialize at program 0, accumulate each step).
+
+Numerics are bit-compatible with ops/losses.py `bce_dice_stats` (same
+clamp at -100, same `== 1` binarization — reference utils/utils.py:14-25);
+the equivalence test runs the kernel in interpret mode on CPU and real
+mode on TPU.
+
+Used on the no-grad paths (evaluation; anywhere stats are consumed without
+autodiff). The training loss keeps the XLA path: differentiating a Pallas
+kernel needs a hand-written VJP, and grad-parity risk there buys nothing
+while the step is conv-dominated.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM = pltpu.SMEM
+    _VMEM = pltpu.VMEM
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _SMEM = _VMEM = None
+
+_LOG_CLAMP = -100.0  # torch BCELoss log clamp (ops/losses.py)
+
+LANES = 128  # TPU vector lane width
+BLOCK_ROWS = 512  # (512, 128) f32 block = 256 KB per input — fits VMEM
+
+
+def _stats_kernel(p_ref, t_ref, out_ref):
+    """One grid step: partial BCE/dice sums of a (BLOCK_ROWS, LANES) tile,
+    accumulated into 4 SMEM scalars laid out as out_ref[0, 0:4]."""
+    p = p_ref[:].astype(jnp.float32)
+    t = t_ref[:].astype(jnp.float32)
+    tb = (t == 1.0).astype(jnp.float32)  # reference utils.py:16 binarize
+    log_p = jnp.maximum(jnp.log(p), _LOG_CLAMP)
+    log_1p = jnp.maximum(jnp.log(1.0 - p), _LOG_CLAMP)
+    per_elem = -(tb * log_p + (1.0 - tb) * log_1p)
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        out_ref[0, 0] = 0.0
+        out_ref[0, 1] = 0.0
+        out_ref[0, 2] = 0.0
+        out_ref[0, 3] = 0.0
+
+    out_ref[0, 0] += jnp.sum(per_elem)  # bce numerator
+    out_ref[0, 2] += jnp.sum(p * tb)  # dice intersection
+    out_ref[0, 3] += jnp.sum(p) + jnp.sum(tb)  # dice union (o.sum + t.sum)
+
+
+def _auto_interpret() -> bool:
+    """Real Mosaic lowering on TPU; the Pallas interpreter elsewhere (CPU
+    test meshes, GPU). One place decides — callers pass interpret=None."""
+    return jax.devices()[0].platform != "tpu"
+
+
+def _stats_call(p2, t2, n, num_blocks, interpret):
+    # no jit here: n/num_blocks/grid must stay static, and callers (the
+    # jitted eval step; tests) already run this under their own trace
+    if not interpret and _SMEM is not None:
+        in_space, out_space = _VMEM, _SMEM
+    else:  # interpreter has no TPU memory spaces
+        in_space = out_space = None
+
+    def spec(block, index_map, space):
+        if space is None:
+            return pl.BlockSpec(block, index_map)
+        return pl.BlockSpec(block, index_map, memory_space=space)
+
+    stats = pl.pallas_call(
+        _stats_kernel,
+        grid=(num_blocks,),
+        in_specs=[
+            spec((BLOCK_ROWS, LANES), lambda i: (i, 0), in_space),
+            spec((BLOCK_ROWS, LANES), lambda i: (i, 0), in_space),
+        ],
+        out_specs=spec((1, 4), lambda i: (0, 0), out_space),
+        out_shape=jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        interpret=interpret,
+    )(p2, t2)
+    return jnp.stack(
+        [stats[0, 0], jnp.float32(n), stats[0, 2], stats[0, 3]]
+    )
+
+
+def bce_dice_stats_pallas(
+    outputs: jax.Array, targets: jax.Array, interpret=None
+) -> jax.Array:
+    """Fused one-pass `[bce_sum, count, intersection, union_sum]` — the same
+    contract as ops/losses.py `bce_dice_stats`, one VMEM read per element.
+
+    Padding invariant: tiles are padded with (p=0, t=0), which contributes
+    exactly zero to every accumulator — per_elem = -log(1-0) = 0, p·tb = 0,
+    p + tb = 0 — so no masking is needed in the kernel; the true element
+    count is patched in outside.
+
+    `interpret=None` auto-selects: Mosaic on TPU, interpreter elsewhere.
+    The inputs must be unsharded (single device or replicated): pallas_call
+    has no GSPMD partitioning rule, so callers on sharded meshes must not
+    route sharded arrays here (see make_eval_step's gating).
+    """
+    if interpret is None:
+        interpret = _auto_interpret()
+    p = outputs.astype(jnp.float32).reshape(-1)
+    t = targets.astype(jnp.float32).reshape(-1)
+    n = p.size
+    per_block = BLOCK_ROWS * LANES
+    num_blocks = max(1, -(-n // per_block))
+    pad = num_blocks * per_block - n
+    p = jnp.pad(p, (0, pad)).reshape(num_blocks * BLOCK_ROWS, LANES)
+    t = jnp.pad(t, (0, pad)).reshape(num_blocks * BLOCK_ROWS, LANES)
+    return _stats_call(p, t, n, num_blocks, interpret)
+
+
+def bce_dice_loss_pallas(
+    outputs: jax.Array, targets: jax.Array, interpret=None
+) -> jax.Array:
+    """Scalar BCE − log-dice via the fused kernel (no-grad paths only)."""
+    from distributedpytorch_tpu.ops.losses import loss_from_stats
+
+    return loss_from_stats(bce_dice_stats_pallas(outputs, targets, interpret=interpret))

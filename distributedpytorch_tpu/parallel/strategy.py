@@ -130,7 +130,27 @@ class Strategy:
         )
 
     def build_eval_step(self, model) -> Callable:
-        return jax.jit(make_eval_step(model))
+        return jax.jit(make_eval_step(model, use_pallas=self._pallas_eval()))
+
+    def _pallas_eval(self) -> bool:
+        """`use_pallas` applies only where the eval batch is unsharded
+        (single device / replicated): pallas_call has no GSPMD partitioning
+        rule, so a mesh-sharded (B,H,W,1) input would fail to lower or
+        force a de-shard. Sharded strategies fall back to the XLA loss,
+        loudly."""
+        if not self.config.use_pallas:
+            return False
+        if self.mesh is not None:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "--pallas: the fused eval-loss kernel runs only on "
+                "unsharded eval batches; strategy %s evaluates through a "
+                "mesh, keeping the XLA loss path",
+                self.name,
+            )
+            return False
+        return True
 
 
 class SingleDevice(Strategy):
@@ -315,6 +335,7 @@ class Pipeline(Strategy):
     def build_eval_step(self, model) -> Callable:
         # Eval runs the pipelined forward too (the reference evaluates
         # through the pipe model, train.py:62-64 → evaluate.py).
+        self._pallas_eval()  # warn if --pallas was requested: mesh strategy
         fwd = make_pipeline_forward_fn(
             model, self.mesh, num_microbatches=self.config.num_microbatches
         )
@@ -386,6 +407,7 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
         )
 
     def build_eval_step(self, model) -> Callable:
+        self._pallas_eval()  # warn if --pallas was requested: mesh strategy
         fwd = make_pipeline_forward_fn(
             model,
             self.mesh,

@@ -120,15 +120,30 @@ def make_multi_train_step(
     return multi_step
 
 
-def make_eval_step(model) -> Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]:
+def make_eval_step(
+    model, use_pallas: bool = False
+) -> Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]:
     """Eval step: per-batch mean loss (reference evaluate.py:16-19) plus the
-    hard-Dice metric the reference never computes (SURVEY.md §2 quirk 6)."""
+    hard-Dice metric the reference never computes (SURVEY.md §2 quirk 6).
+
+    `use_pallas` routes the loss through the fused one-pass Pallas stats
+    kernel (ops/pallas_kernels.py) — numerics-identical, eval-only (the
+    train loss stays XLA so autodiff needs no hand-written VJP).
+    """
 
     def eval_step(params, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
         preds = model.apply({"params": params}, batch["image"])
         target = _prep_mask(batch["mask"])
+        if use_pallas:
+            from distributedpytorch_tpu.ops.pallas_kernels import (
+                bce_dice_loss_pallas,
+            )
+
+            loss = bce_dice_loss_pallas(preds, target)
+        else:
+            loss = bce_dice_loss(preds, target)
         return {
-            "loss": bce_dice_loss(preds, target),
+            "loss": loss,
             "dice": dice_coefficient(preds, target),
         }
 
