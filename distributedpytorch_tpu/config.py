@@ -82,11 +82,12 @@ class TrainConfig:
     remat: bool = False
 
     # -- kernels ------------------------------------------------------------
-    # Route the eval loss through the fused Pallas stats kernel
-    # (ops/pallas_kernels.py). Numerics-identical to the XLA path; takes
-    # effect only on strategies whose eval batch is unsharded (singleGPU —
-    # pallas_call has no GSPMD partition rule); sharded strategies warn and
-    # keep the XLA loss. Off by default.
+    # Route the eval loss+Dice through the fused one-pass Pallas stats
+    # kernel (ops/pallas_kernels.py). Same formulas as the XLA path, equal
+    # within summation-order tolerance (~1e-5 relative); takes effect only
+    # on strategies whose eval batch is unsharded (singleGPU — pallas_call
+    # has no GSPMD partition rule); sharded strategies warn and keep the
+    # XLA path. Off by default.
     use_pallas: bool = False
 
     # -- dispatch amortization ----------------------------------------------

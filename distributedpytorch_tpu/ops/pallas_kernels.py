@@ -12,9 +12,11 @@ in registers, and four scalar accumulators in SMEM carry the running sums
 across the sequential grid (the standard Pallas reduction pattern:
 initialize at program 0, accumulate each step).
 
-Numerics are bit-compatible with ops/losses.py `bce_dice_stats` (same
-clamp at -100, same `== 1` binarization — reference utils/utils.py:14-25);
-the equivalence test runs the kernel in interpret mode on CPU and real
+Numerics follow ops/losses.py exactly in formula (same clamp at -100, same
+`== 1` binarization — reference utils/utils.py:14-25) but NOT bit-for-bit:
+multi-block accumulation sums in a different order than XLA's reduction
+tree, so results agree to ~1e-5 relative (the equivalence tests' tolerance),
+not exactly. The tests run the kernel in interpret mode on CPU and real
 mode on TPU.
 
 Used on the no-grad paths (evaluation; anywhere stats are consumed without
@@ -45,25 +47,27 @@ BLOCK_ROWS = 512  # (512, 128) f32 block = 256 KB per input — fits VMEM
 
 
 def _stats_kernel(p_ref, t_ref, out_ref):
-    """One grid step: partial BCE/dice sums of a (BLOCK_ROWS, LANES) tile,
-    accumulated into 4 SMEM scalars laid out as out_ref[0, 0:4]."""
+    """One grid step: partial BCE + soft-dice + hard-dice sums of a
+    (BLOCK_ROWS, LANES) tile, accumulated into 6 SMEM scalars laid out as
+    out_ref[0, 0:6] (slot 1 is patched with the element count outside)."""
     p = p_ref[:].astype(jnp.float32)
     t = t_ref[:].astype(jnp.float32)
     tb = (t == 1.0).astype(jnp.float32)  # reference utils.py:16 binarize
+    pb = (p >= 0.5).astype(jnp.float32)  # hard-dice threshold (losses.py)
     log_p = jnp.maximum(jnp.log(p), _LOG_CLAMP)
     log_1p = jnp.maximum(jnp.log(1.0 - p), _LOG_CLAMP)
     per_elem = -(tb * log_p + (1.0 - tb) * log_1p)
 
     @pl.when(pl.program_id(0) == 0)
     def _init():
-        out_ref[0, 0] = 0.0
-        out_ref[0, 1] = 0.0
-        out_ref[0, 2] = 0.0
-        out_ref[0, 3] = 0.0
+        for j in range(6):
+            out_ref[0, j] = 0.0
 
     out_ref[0, 0] += jnp.sum(per_elem)  # bce numerator
-    out_ref[0, 2] += jnp.sum(p * tb)  # dice intersection
-    out_ref[0, 3] += jnp.sum(p) + jnp.sum(tb)  # dice union (o.sum + t.sum)
+    out_ref[0, 2] += jnp.sum(p * tb)  # soft-dice intersection
+    out_ref[0, 3] += jnp.sum(p) + jnp.sum(tb)  # soft-dice union
+    out_ref[0, 4] += jnp.sum(pb * tb)  # hard-dice intersection
+    out_ref[0, 5] += jnp.sum(pb) + jnp.sum(tb)  # hard-dice union
 
 
 def _auto_interpret() -> bool:
@@ -92,20 +96,29 @@ def _stats_call(p2, t2, n, num_blocks, interpret):
             spec((BLOCK_ROWS, LANES), lambda i: (i, 0), in_space),
             spec((BLOCK_ROWS, LANES), lambda i: (i, 0), in_space),
         ],
-        out_specs=spec((1, 4), lambda i: (0, 0), out_space),
-        out_shape=jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        out_specs=spec((1, 6), lambda i: (0, 0), out_space),
+        out_shape=jax.ShapeDtypeStruct((1, 6), jnp.float32),
         interpret=interpret,
     )(p2, t2)
     return jnp.stack(
-        [stats[0, 0], jnp.float32(n), stats[0, 2], stats[0, 3]]
+        [
+            stats[0, 0],
+            jnp.float32(n),
+            stats[0, 2],
+            stats[0, 3],
+            stats[0, 4],
+            stats[0, 5],
+        ]
     )
 
 
-def bce_dice_stats_pallas(
+def eval_stats_pallas(
     outputs: jax.Array, targets: jax.Array, interpret=None
 ) -> jax.Array:
-    """Fused one-pass `[bce_sum, count, intersection, union_sum]` — the same
-    contract as ops/losses.py `bce_dice_stats`, one VMEM read per element.
+    """Fused one-pass `[bce_sum, count, soft_inter, soft_union, hard_inter,
+    hard_union]`: the first four are ops/losses.py `bce_dice_stats`, the
+    last two are the hard-Dice metric's sums — everything the eval step
+    needs from ONE VMEM read per element.
 
     Padding invariant: tiles are padded with (p=0, t=0), which contributes
     exactly zero to every accumulator — per_elem = -log(1-0) = 0, p·tb = 0,
@@ -130,6 +143,13 @@ def bce_dice_stats_pallas(
     return _stats_call(p, t, n, num_blocks, interpret)
 
 
+def bce_dice_stats_pallas(
+    outputs: jax.Array, targets: jax.Array, interpret=None
+) -> jax.Array:
+    """ops/losses.py `bce_dice_stats` contract (4 stats) via the kernel."""
+    return eval_stats_pallas(outputs, targets, interpret=interpret)[:4]
+
+
 def bce_dice_loss_pallas(
     outputs: jax.Array, targets: jax.Array, interpret=None
 ) -> jax.Array:
@@ -137,3 +157,16 @@ def bce_dice_loss_pallas(
     from distributedpytorch_tpu.ops.losses import loss_from_stats
 
     return loss_from_stats(bce_dice_stats_pallas(outputs, targets, interpret=interpret))
+
+
+def eval_metrics_pallas(
+    outputs: jax.Array, targets: jax.Array, interpret=None, dice_eps: float = 1e-7
+) -> dict:
+    """{'loss', 'dice'} for the eval step from one fused pass — BCE −
+    log-dice (losses.py `bce_dice_loss`) and hard Dice (losses.py
+    `dice_coefficient`, threshold 0.5, same eps)."""
+    from distributedpytorch_tpu.ops.losses import loss_from_stats
+
+    stats = eval_stats_pallas(outputs, targets, interpret=interpret)
+    dice = (2.0 * stats[4] + dice_eps) / (stats[5] + dice_eps)
+    return {"loss": loss_from_stats(stats[:4]), "dice": dice}

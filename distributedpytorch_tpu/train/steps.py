@@ -126,9 +126,11 @@ def make_eval_step(
     """Eval step: per-batch mean loss (reference evaluate.py:16-19) plus the
     hard-Dice metric the reference never computes (SURVEY.md §2 quirk 6).
 
-    `use_pallas` routes the loss through the fused one-pass Pallas stats
-    kernel (ops/pallas_kernels.py) — numerics-identical, eval-only (the
-    train loss stays XLA so autodiff needs no hand-written VJP).
+    `use_pallas` computes loss AND hard-Dice from the fused one-pass
+    Pallas stats kernel (ops/pallas_kernels.py) — same formulas, equal to
+    the XLA path within summation-order tolerance (~1e-5 relative).
+    Eval-only: the train loss stays XLA so autodiff needs no hand-written
+    VJP.
     """
 
     def eval_step(params, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
@@ -136,14 +138,12 @@ def make_eval_step(
         target = _prep_mask(batch["mask"])
         if use_pallas:
             from distributedpytorch_tpu.ops.pallas_kernels import (
-                bce_dice_loss_pallas,
+                eval_metrics_pallas,
             )
 
-            loss = bce_dice_loss_pallas(preds, target)
-        else:
-            loss = bce_dice_loss(preds, target)
+            return eval_metrics_pallas(preds, target)
         return {
-            "loss": loss,
+            "loss": bce_dice_loss(preds, target),
             "dice": dice_coefficient(preds, target),
         }
 

@@ -46,6 +46,22 @@ def test_loss_matches_including_log_clamp():
     np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-5)
 
 
+def test_eval_metrics_parity():
+    """The fused eval path ({'loss','dice'} from one kernel pass) matches
+    losses.py bce_dice_loss + dice_coefficient."""
+    from distributedpytorch_tpu.ops.losses import dice_coefficient
+    from distributedpytorch_tpu.ops.pallas_kernels import eval_metrics_pallas
+
+    p, t = _case((4, 320, 240, 1), seed=3)  # 5 grid blocks
+    got = eval_metrics_pallas(p, t)
+    np.testing.assert_allclose(
+        float(got["loss"]), float(bce_dice_loss(p, t)), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(got["dice"]), float(dice_coefficient(p, t)), rtol=1e-5
+    )
+
+
 def test_binarization_parity():
     """Targets with values outside {0,1} binarize via == 1 (reference
     utils.py:16), in kernel and reference alike."""
