@@ -61,6 +61,31 @@ def save_checkpoint(
     os.replace(tmp, path)
 
 
+def resolve_checkpoint(name: str, checkpoint_dir: str = "./checkpoints") -> str:
+    """Resolve a checkpoint reference to an existing file path.
+
+    Accepts an explicit path (``./ckpts/run.ckpt``), a bare method name
+    (``DP`` → ``<dir>/DP.ckpt``, falling back to ``<dir>/DP.pth``), or an
+    extension-suffixed name (``DP.pth`` → resolved inside `checkpoint_dir`,
+    matching the trainer's ``-c``/-l`` semantics, train/loop.py). Raises
+    FileNotFoundError naming the primary candidate when nothing exists.
+    """
+    if os.path.exists(name):
+        return name
+    base = name
+    for ext in (".ckpt", ".pth"):
+        if base.endswith(ext):
+            base = base[: -len(ext)]
+            break
+    ckpt = os.path.join(checkpoint_dir, f"{base}.ckpt")
+    if os.path.exists(ckpt):
+        return ckpt
+    pth = os.path.join(checkpoint_dir, f"{base}.pth")
+    if os.path.exists(pth):
+        return pth
+    raise FileNotFoundError(ckpt)
+
+
 def load_checkpoint(
     path: str, params_target, opt_state_target=None
 ) -> Dict[str, Any]:

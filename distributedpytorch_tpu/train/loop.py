@@ -148,22 +148,18 @@ class Trainer:
     def _restore(self, name: str, state):
         """Load a checkpoint by name (reference -c flag, train.py:42-43 —
         with the backslash path bug fixed and full-state resume added)."""
-        for ext in (".ckpt", ".pth"):
-            if name.endswith(ext):  # tolerate '-l DP.pth'-style full names
-                name = name[: -len(ext)]
-        path = os.path.join(self.config.checkpoint_dir, f"{name}.ckpt")
-        self._restored_state = None
-        if not os.path.exists(path):
-            # interop: a reference-format .pth of the same name
-            pth = os.path.join(self.config.checkpoint_dir, f"{name}.pth")
-            if os.path.exists(pth):
-                from distributedpytorch_tpu.checkpoint import import_reference_pth
+        from distributedpytorch_tpu.checkpoint import resolve_checkpoint
 
-                params = import_reference_pth(pth, state.params)
-                self._restored_state = state.replace(params=params)
-                logger.info("Loaded reference .pth weights from %s", pth)
-                return
-            raise FileNotFoundError(path)
+        path = resolve_checkpoint(name, self.config.checkpoint_dir)
+        self._restored_state = None
+        if path.endswith(".pth"):
+            # interop: reference-format weights (no optimizer/epoch state)
+            from distributedpytorch_tpu.checkpoint import import_reference_pth
+
+            params = import_reference_pth(path, state.params)
+            self._restored_state = state.replace(params=params)
+            logger.info("Loaded reference .pth weights from %s", path)
+            return
         restored = load_checkpoint(path, state.params, state.opt_state)
         new_state = state.replace(params=restored["params"], step=restored["step"])
         if restored["opt_state"] is not None:
