@@ -70,20 +70,30 @@ def resolve_checkpoint(name: str, checkpoint_dir: str = "./checkpoints") -> str:
     matching the trainer's ``-c``/-l`` semantics, train/loop.py). Raises
     FileNotFoundError naming the primary candidate when nothing exists.
     """
-    if os.path.exists(name):
+    if os.path.isfile(name):  # isfile: a same-named DIRECTORY must not shadow
         return name
-    base = name
+    base, explicit_ext = name, None
     for ext in (".ckpt", ".pth"):
         if base.endswith(ext):
-            base = base[: -len(ext)]
+            base, explicit_ext = base[: -len(ext)], ext
             break
-    ckpt = os.path.join(checkpoint_dir, f"{base}.ckpt")
-    if os.path.exists(ckpt):
-        return ckpt
-    pth = os.path.join(checkpoint_dir, f"{base}.pth")
-    if os.path.exists(pth):
-        return pth
-    raise FileNotFoundError(ckpt)
+    # an explicitly-suffixed name tries ONLY that format — 'DP.pth' must
+    # never silently load DP.ckpt when both exist
+    exts = (explicit_ext,) if explicit_ext else (".ckpt", ".pth")
+    for ext in exts:
+        cand = os.path.join(checkpoint_dir, f"{base}{ext}")
+        if os.path.isfile(cand):
+            return cand
+    raise FileNotFoundError(os.path.join(checkpoint_dir, f"{base}{exts[0]}"))
+
+
+def load_weights(path: str, params_template):
+    """Params from either checkpoint format: native full-state ``.ckpt`` or
+    reference ``.pth`` (NHWC↔NCHW transposes, ``module.`` prefix tolerated).
+    The format rule lives here only — trainer resume and inference share it."""
+    if path.endswith(".pth"):
+        return import_reference_pth(path, params_template)
+    return load_checkpoint(path, params_template, None)["params"]
 
 
 def load_checkpoint(

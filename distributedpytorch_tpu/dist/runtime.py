@@ -60,6 +60,21 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
     if _INITIALIZED:
         return RuntimeInfo(jax.process_index(), jax.process_count(), None)
 
+    # Real multi-host TPU pods: argless initialize() autodetects the pod's
+    # own coordinator from the TPU runtime/cloud metadata. Opt-in (env
+    # flag) because on single-host and tunneled setups the detection probes
+    # would stall startup.
+    if os.environ.get("DPT_JAX_AUTO_INIT") == "1":
+        jax.distributed.initialize()
+        _INITIALIZED = True
+        info = RuntimeInfo(jax.process_index(), jax.process_count(), None)
+        logger.info(
+            "jax.distributed auto-initialized: process %d/%d",
+            info.process_id,
+            info.num_processes,
+        )
+        return info
+
     coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if coord:
         info = RuntimeInfo(

@@ -58,20 +58,15 @@ def predict_batches(
 
 
 def load_params_for_inference(checkpoint_path: str, model, input_hw: Tuple[int, int]):
-    """Params from a native .ckpt or a reference-format .pth."""
+    """Params from a native .ckpt or a reference-format .pth (the format
+    dispatch lives in checkpoint.load_weights, shared with the trainer)."""
     import jax
 
-    from distributedpytorch_tpu.checkpoint import (
-        import_reference_pth,
-        load_checkpoint,
-    )
+    from distributedpytorch_tpu.checkpoint import load_weights
     from distributedpytorch_tpu.models.unet import init_unet_params
 
     template = init_unet_params(model, jax.random.key(0), input_hw=input_hw)
-    if checkpoint_path.endswith(".pth"):
-        return import_reference_pth(checkpoint_path, template)
-    restored = load_checkpoint(checkpoint_path, template, None)
-    return restored["params"]
+    return load_weights(checkpoint_path, template)
 
 
 def run_prediction(
@@ -113,6 +108,19 @@ def run_prediction(
         raise RuntimeError(f"No input images found in {input_dir}")
     os.makedirs(output_dir, exist_ok=True)
 
+    # Output names: stem-based, but inputs differing only by extension
+    # (car1.jpg + car1.png) must not clobber each other's masks — such
+    # stems keep their extension in the output name.
+    stem_counts: dict = {}
+    for f in files:
+        stem_counts[os.path.splitext(f)[0]] = (
+            stem_counts.get(os.path.splitext(f)[0], 0) + 1
+        )
+
+    def out_stem(fname: str) -> str:
+        stem, ext = os.path.splitext(fname)
+        return stem if stem_counts[stem] == 1 else f"{stem}_{ext.lstrip('.')}"
+
     def load_stream() -> Iterator[np.ndarray]:
         for f in files:
             img = BasicDataset.load(os.path.join(input_dir, f))
@@ -125,7 +133,7 @@ def run_prediction(
     idx = 0
     for probs, inputs in predict_batches(params, model, load_stream(), batch_size):
         for prob, inp in zip(probs, inputs):
-            stem = os.path.splitext(files[idx])[0]
+            stem = out_stem(files[idx])
             mask = (prob >= threshold).astype(np.uint8) * 255
             out_path = os.path.join(output_dir, f"{stem}_mask.png")
             Image.fromarray(mask).save(out_path)

@@ -154,10 +154,11 @@ class Trainer:
         self._restored_state = None
         if path.endswith(".pth"):
             # interop: reference-format weights (no optimizer/epoch state)
-            from distributedpytorch_tpu.checkpoint import import_reference_pth
+            from distributedpytorch_tpu.checkpoint import load_weights
 
-            params = import_reference_pth(path, state.params)
-            self._restored_state = state.replace(params=params)
+            self._restored_state = state.replace(
+                params=load_weights(path, state.params)
+            )
             logger.info("Loaded reference .pth weights from %s", path)
             return
         restored = load_checkpoint(path, state.params, state.opt_state)

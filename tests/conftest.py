@@ -19,6 +19,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# Persistent XLA compilation cache: repeat suite runs (and the many
+# structurally-identical tiny-model compiles within one run) hit disk
+# instead of recompiling. Harmless no-op on jax versions without it.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/dpt_test_xla_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 
 def pytest_configure(config):

@@ -130,6 +130,7 @@ def test_steps_per_dispatch_ragged_tail(tmp_path):
     _compare_k_dispatch(tmp_path, "singleGPU", batch_size=5, epochs=1)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("method", ["DP", "MP"])
 def test_steps_per_dispatch_sharded(method, tmp_path):
     """K>1 across a mesh: the stacked batch sharding (leading K axis never
