@@ -184,8 +184,26 @@ def run() -> dict:
 def main():
     try:
         result = run()
-    except Exception as exc:  # the artifact must never be empty/unparseable
-        result = {
+    except Exception as exc:
+        # One retry IN A FRESH PROCESS: jax caches backend-init results
+        # process-wide, so an in-process retry after a failed TPU claim
+        # would silently fall back to the cached CPU backend instead of
+        # re-attempting the claim. exec() replaces this process; the
+        # child's JSON line becomes the artifact.
+        if os.environ.get("_DPT_BENCH_RETRY") != "1":
+            print(
+                f"bench: {type(exc).__name__}: {exc}; retrying in a fresh "
+                "process after 60s",
+                file=sys.stderr,
+            )
+            time.sleep(60)
+            env = dict(os.environ)
+            env["_DPT_BENCH_RETRY"] = "1"
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os.execve(sys.executable,
+                      [sys.executable, os.path.abspath(__file__)], env)
+        result = {  # the artifact must never be empty/unparseable
             "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_error",
             "value": 0.0,
             "unit": "imgs/sec",

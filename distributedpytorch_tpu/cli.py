@@ -64,6 +64,10 @@ def get_args():
                              "(~half HBM, ~1/3 more FLOPs)")
     parser.add_argument("--pallas", action="store_true",
                         help="Use the fused Pallas loss-stats kernel for eval")
+    parser.add_argument("--model-widths", type=int, nargs="+", default=None,
+                        help="Encoder channel widths (default 32 64 128 256, "
+                             "the reference model; e.g. 64 128 256 512 for a "
+                             "4x wider ~31M-param variant)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Capture a jax.profiler trace here")
     parser.add_argument("--export-pth", action="store_true",
@@ -118,6 +122,7 @@ def main():
         steps_per_dispatch=args.steps_per_dispatch,
         remat=args.remat,
         use_pallas=args.pallas,
+        model_widths=tuple(args.model_widths) if args.model_widths else None,
         checkpoint_name=args.checkpoint or (args.load if args.load else None),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,

@@ -40,6 +40,11 @@ def test_two_process_ddp(tmp_path):
                 "JAX_PLATFORMS": "cpu",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
                 "PALLAS_AXON_POOL_IPS": "",
+                # per-rank but PERSISTENT compilation cache: splitting by
+                # rank avoids two ranks racing on identical entries, while
+                # keeping warm-cache speed across runs (tmp_path would be
+                # cold every invocation)
+                "JAX_COMPILATION_CACHE_DIR": f"/tmp/dpt_test_xla_cache_rank{rank}",
             }
         )
         procs.append(
@@ -53,7 +58,7 @@ def test_two_process_ddp(tmp_path):
             )
         )
 
-    outputs = [p.communicate(timeout=540)[0] for p in procs]
+    outputs = [p.communicate(timeout=900)[0] for p in procs]
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
 
