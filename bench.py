@@ -189,8 +189,13 @@ def main():
         # process-wide, so an in-process retry after a failed TPU claim
         # would silently fall back to the cached CPU backend instead of
         # re-attempting the claim. exec() replaces this process; the
-        # child's JSON line becomes the artifact.
-        if os.environ.get("_DPT_BENCH_RETRY") != "1":
+        # child's JSON line becomes the artifact. Only runtime/backend
+        # errors warrant it — deterministic failures (ImportError, bad
+        # config) would just fail again after a futile minute.
+        retryable = isinstance(
+            exc, (RuntimeError, OSError, ConnectionError, TimeoutError)
+        )
+        if retryable and os.environ.get("_DPT_BENCH_RETRY") != "1":
             print(
                 f"bench: {type(exc).__name__}: {exc}; retrying in a fresh "
                 "process after 60s",

@@ -31,7 +31,7 @@ def get_args():
     # reference flags (train.py:15-26)
     parser.add_argument("--train-method", "-t", type=str, default="singleGPU",
                         help="Training method: singleGPU | DP | DDP | MP | DDP_MP "
-                             "| SP | DDP_SP")
+                             "| SP | DDP_SP | TP | FSDP")
     parser.add_argument("--validation", "-v", dest="val", type=float, default=10.0,
                         help="Percentage of data used as validation")
     parser.add_argument("--load", "-l", type=str, default=False,

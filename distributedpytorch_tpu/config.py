@@ -16,7 +16,9 @@ class TrainConfig:
     # -- strategy -----------------------------------------------------------
     # one of: "singleGPU" (kept for CLI parity; means single-device),
     # "DP", "DDP", "MP", "DDP_MP" (hybrid, new capability),
-    # "SP" / "DDP_SP" (spatial sharding of the image plane, new capability)
+    # "SP" / "DDP_SP" (spatial sharding of the image plane, new),
+    # "TP" (out-channel tensor parallelism, new),
+    # "FSDP" (ZeRO-style fully sharded data parallel, new)
     train_method: str = "singleGPU"
 
     # -- optimization (reference train.py:18-24 defaults) -------------------

@@ -509,6 +509,119 @@ class HybridDataSpatial(MultiProcessMixin, SpatialParallel):
         return True
 
 
+def _shard_state_by_rule(state, mesh: Mesh, leaf_spec, strategy_name: str) -> Any:
+    """Place a TrainState with per-leaf PartitionSpecs chosen by
+    `leaf_spec(shape) -> PartitionSpec`. Adam's m/v mirror the param
+    shapes, so one shape-driven rule shards params and optimizer state
+    consistently; scalars (step/count) replicate.
+
+    Warns loudly when NO leaf shards: the strategy then degenerates to
+    fully replicated compute (every device does the whole model) — legal,
+    but certainly not what the user asked for.
+    """
+    sharded = 0
+
+    def place(x):
+        nonlocal sharded
+        spec = leaf_spec(getattr(x, "shape", ()))
+        if any(s is not None for s in spec):
+            sharded += 1
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    placed = jax.tree.map(place, state)
+    if sharded == 0:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s: no parameter axis divides the %d-device mesh — state is "
+            "fully replicated and every device computes the whole model "
+            "(no parallel speedup or memory saving). Use a device count "
+            "that divides the channel widths.",
+            strategy_name,
+            mesh.devices.size,
+        )
+    return placed
+
+
+class TensorParallel(Strategy):
+    """``-t TP``: tensor (model) parallelism — conv output channels sharded
+    over a ('model',) mesh axis. A capability the reference lacks entirely
+    (SURVEY.md §2: "TP … absent from reference").
+
+    TPU-native form: pure sharding annotation. Every conv kernel
+    (Kh, Kw, Cin, Cout) and bias is sharded on its out-channel axis; the
+    batch is replicated. Under GSPMD each device then computes its channel
+    slice of every layer, and XLA inserts the collectives where channels
+    must be whole (the next layer contracts over the sharded Cin; skip
+    concats; the 1-channel segmap head stays replicated — its Cout=1 does
+    not divide). Parameters AND Adam state are sharded, so per-chip
+    parameter memory drops by the mesh size — the memory effect of
+    Megatron-style TP without hand-written collectives.
+
+    Channel plan divisibility: widths 32..512 divide any power-of-two mesh
+    up to 8; kernels whose out-axis does not divide (segmap, tiny test
+    widths) replicate, which GSPMD handles per-tensor.
+    """
+
+    name = "TP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        super().__init__(config)
+        devs = list(devices if devices is not None else jax.local_devices())
+        self.mesh = Mesh(np.array(devs), ("model",))
+        self.batch_sharding = NamedSharding(self.mesh, P())
+
+    def _leaf_spec(self, shape) -> P:
+        size = self.mesh.shape["model"]
+        if len(shape) == 0:
+            return P()
+        if shape[-1] % size == 0 and shape[-1] >= size:
+            # out-channel axis of conv kernels / biases
+            return P(*([None] * (len(shape) - 1)), "model")
+        return P()
+
+    def place_state(self, state):
+        return _shard_state_by_rule(state, self.mesh, self._leaf_spec, self.name)
+
+    def place_batch(self, batch):
+        return {k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()}
+
+    def place_stacked_batch(self, stacked):
+        return self.place_batch(stacked)  # replicated either way
+
+
+class FullyShardedDataParallel(DataParallel):
+    """``-t FSDP``: ZeRO-3-style fully sharded data parallel — another
+    capability the reference lacks (SURVEY.md §2: "FSDP/ZeRO — full
+    replica per device").
+
+    Batch sharded over ('data',) exactly like DP, but parameters and Adam
+    state are ALSO sharded over 'data' (each leaf along its largest
+    divisible axis). GSPMD inserts the per-layer all-gather of params in
+    the forward/backward and the reduce-scatter of gradients — the ZeRO
+    dance — from annotations alone. Per-chip state memory drops by the
+    mesh size; compute matches DP.
+    """
+
+    name = "FSDP"
+
+    def _leaf_spec(self, shape) -> P:
+        size = self.mesh.shape["data"]
+        if len(shape) == 0:
+            return P()
+        # shard the largest axis that divides the mesh; else replicate
+        axes = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for i in axes:
+            if shape[i] % size == 0 and shape[i] >= size:
+                spec = [None] * len(shape)
+                spec[i] = "data"
+                return P(*spec)
+        return P()
+
+    def place_state(self, state):
+        return _shard_state_by_rule(state, self.mesh, self._leaf_spec, self.name)
+
+
 STRATEGIES = {
     cls.name: cls
     for cls in (
@@ -519,6 +632,8 @@ STRATEGIES = {
         HybridDataPipeline,
         SpatialParallel,
         HybridDataSpatial,
+        TensorParallel,
+        FullyShardedDataParallel,
     )
 }
 

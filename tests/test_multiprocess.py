@@ -3,6 +3,7 @@ each, rendezvous over localhost with torchrun-style env — the real
 `jax.distributed` path the single-process mesh tests cannot cover
 (SURVEY.md §4: 'multi-process tests via jax.distributed over localhost')."""
 
+import getpass
 import json
 import os
 import socket
@@ -43,8 +44,11 @@ def test_two_process_ddp(tmp_path):
                 # per-rank but PERSISTENT compilation cache: splitting by
                 # rank avoids two ranks racing on identical entries, while
                 # keeping warm-cache speed across runs (tmp_path would be
-                # cold every invocation)
-                "JAX_COMPILATION_CACHE_DIR": f"/tmp/dpt_test_xla_cache_rank{rank}",
+                # cold every invocation); per-user so shared machines don't
+                # collide on /tmp ownership
+                "JAX_COMPILATION_CACHE_DIR": (
+                    f"/tmp/dpt_test_xla_cache_{getpass.getuser()}_rank{rank}"
+                ),
             }
         )
         procs.append(
