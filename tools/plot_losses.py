@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""Render the training artifacts to PNG: loss/Dice curves from the
+reference-schema pickles (``./loss/{method}/{train,val}_loss.pkl`` with
+columns [Step, Time, Loss] — reference utils/train_utils.py:89-92 — plus
+this framework's ``val_dice.pkl``).
+
+The reference writes these pickles and never reads them; this closes the
+loop. Multiple methods overlay on one axis pair — the cross-method
+comparability that exists here because every strategy shares one seeded
+split (reference quirk 5, fixed).
+
+Usage:  python tools/plot_losses.py [--loss-dir ./loss] [-o losses.png] [method ...]
+"""
+
+import argparse
+import os
+
+
+def plot_losses(loss_dir: str, out_path: str, methods=None) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt
+    import pandas as pd
+
+    if not os.path.isdir(loss_dir):
+        raise RuntimeError(
+            f"Loss directory {loss_dir!r} does not exist — run a training "
+            "first (it writes ./loss/<method>/train_loss.pkl)"
+        )
+    if not methods:
+        methods = sorted(
+            d
+            for d in os.listdir(loss_dir)
+            if os.path.isfile(os.path.join(loss_dir, d, "train_loss.pkl"))
+        )
+    if not methods:
+        raise RuntimeError(f"No method subdirectories with pickles in {loss_dir}")
+
+    fig, (ax_train, ax_val) = plt.subplots(1, 2, figsize=(11, 4))
+    for method in methods:
+        mdir = os.path.join(loss_dir, method)
+        train = pd.read_pickle(os.path.join(mdir, "train_loss.pkl"))
+        ax_train.plot(train["Step"], train["Loss"], label=method)
+        val_path = os.path.join(mdir, "val_loss.pkl")
+        if os.path.isfile(val_path):
+            val = pd.read_pickle(val_path)
+            if len(val):
+                ax_val.plot(val["Step"], val["Loss"], marker="o", label=f"{method} loss")
+        dice_path = os.path.join(mdir, "val_dice.pkl")
+        if os.path.isfile(dice_path):
+            dice = pd.read_pickle(dice_path)
+            if len(dice):
+                ax_val.plot(
+                    dice["Step"], dice["Dice"], marker="s", linestyle="--",
+                    label=f"{method} dice",
+                )
+    ax_train.set_title("Train loss (mean of last 10, every 10 steps)")
+    ax_train.set_xlabel("Step")
+    ax_val.set_title("Validation per epoch")
+    ax_val.set_xlabel("Step")
+    for ax in (ax_train, ax_val):
+        ax.legend(fontsize=8)
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("methods", nargs="*", help="methods to plot (default: all found)")
+    ap.add_argument("--loss-dir", default="./loss")
+    ap.add_argument("-o", "--out", default="losses.png")
+    args = ap.parse_args()
+    print(plot_losses(args.loss_dir, args.out, args.methods))
+
+
+if __name__ == "__main__":
+    main()
