@@ -1,0 +1,74 @@
+#!/usr/bin/env python3
+"""Benchmark the data-loading runtime: native C++ whole-batch path
+(native/dpt_data.cpp via data/native.py) vs the pure-PIL path, on a
+synthetic Carvana-layout tree. Prints one JSON line per path.
+
+Usage:  python tools/bench_loader.py [--n 64] [--size 960 640] [--batch 8]
+"""
+
+import argparse
+import json
+import tempfile
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=64, help="images in the tree")
+    ap.add_argument("--size", type=int, nargs=2, default=(960, 640),
+                    metavar=("W", "H"), help="resize target")
+    ap.add_argument("--src-size", type=int, nargs=2, default=(1918, 1280),
+                    metavar=("W", "H"), help="source size (Carvana: 1918x1280)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=4)
+    args = ap.parse_args()
+
+    from distributedpytorch_tpu.data import CarvanaDataset, DataLoader, native
+    from distributedpytorch_tpu.data.dataset import write_synthetic_carvana_tree
+
+    with tempfile.TemporaryDirectory() as tmp:
+        images, masks = write_synthetic_carvana_tree(
+            tmp, n=args.n, size_wh=tuple(args.src_size)
+        )
+        ds = CarvanaDataset(images, masks, newsize=tuple(args.size))
+
+        results = {}
+        for label, use_native in (("native_cpp", True), ("pil", False)):
+            if use_native and native.get_lib() is None:
+                results[label] = None
+                print(json.dumps({"path": label, "error": "library unavailable"}))
+                continue
+            ds.use_native = use_native
+            loader = DataLoader(ds, batch_size=args.batch,
+                                num_workers=args.workers)
+            # warm once (page cache, lazy pool spin-up)
+            next(iter(loader))
+            t0 = time.perf_counter()
+            n_imgs = 0
+            for batch in loader.epoch_batches(0):
+                n_imgs += batch["image"].shape[0]
+            dt = time.perf_counter() - t0
+            results[label] = n_imgs / dt
+            print(
+                json.dumps(
+                    {
+                        "path": label,
+                        "imgs_per_sec": round(n_imgs / dt, 2),
+                        "n": n_imgs,
+                        "resize": f"{args.src_size}->{args.size}",
+                        "batch": args.batch,
+                        "workers": args.workers,
+                    }
+                )
+            )
+        if results.get("native_cpp") and results.get("pil"):
+            print(
+                json.dumps(
+                    {"speedup_native_over_pil": round(
+                        results["native_cpp"] / results["pil"], 2)}
+                )
+            )
+
+
+if __name__ == "__main__":
+    main()
