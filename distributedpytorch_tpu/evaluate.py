@@ -9,7 +9,7 @@ here the same pure apply serves both.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Tuple
+from typing import Callable, Tuple
 
 import numpy as np
 

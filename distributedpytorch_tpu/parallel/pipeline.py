@@ -32,7 +32,7 @@ schedule over 'stage'; `jax.grad`'s transpose inserts the gradient psum over
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp
