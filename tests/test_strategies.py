@@ -234,6 +234,23 @@ class TestStrategySteps:
                 f"{method}: params not actually sharded"
             )
 
+    def test_tp_warns_when_nothing_shards(self, caplog):
+        """Widths that no mesh axis divides → fully replicated state must
+        warn loudly, not silently waste every device."""
+        import logging
+
+        m = UNet(dtype=jnp.float32, widths=(3, 5))  # nothing divides 8
+        p = m.init(jax.random.key(0), jnp.zeros((1, 16, 16, 3)))["params"]
+        strat = build_strategy(
+            TrainConfig(train_method="TP", batch_size=B,
+                        compute_dtype="float32", image_size=(W, H),
+                        model_widths=(3, 5))
+        )
+        state, _ = create_train_state(p, 1e-4)
+        with caplog.at_level(logging.WARNING):
+            strat.place_state(state)
+        assert any("fully replicated" in r.message for r in caplog.records)
+
     def test_remat_matches_plain(self, model, params, batch, single_result):
         """jax.checkpoint rematerialization must be numerics-neutral: same
         loss, same post-step params as the plain single-device step."""
