@@ -1,0 +1,79 @@
+"""Compiler-level sharding proof: the optimized HLO of each strategy's
+train step must contain the collectives its parallelism implies. The
+step-equivalence tests prove the numbers are right; these prove the
+communication actually happens — a strategy that silently degenerated to
+full per-device replication would still pass numerics, but its HLO would
+have no (or the wrong) collectives.
+
+Expected comms (verified against XLA's output on the 8-device CPU mesh):
+  DP    → all-reduce             (gradient reduction)
+  SP    → collective-permute     (halo exchange of boundary rows per conv)
+  TP    → channel resharding     (all-to-all / all-gather / permute)
+  FSDP  → all-gather             (per-layer parameter gathering)
+  MP    → collective-permute     (ppermute stage0→stage1 transfers)
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributedpytorch_tpu.config import TrainConfig
+from distributedpytorch_tpu.models.unet import UNet
+from distributedpytorch_tpu.parallel import build_strategy
+from distributedpytorch_tpu.train.steps import create_train_state
+
+H, W, B = 32, 48, 8
+WIDTHS = (8, 16)
+
+_COLLECTIVE_RE = re.compile(
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
+)
+
+
+def _compiled_collectives(method):
+    cfg = TrainConfig(
+        train_method=method,
+        batch_size=B,
+        compute_dtype="float32",
+        image_size=(W, H),
+        model_widths=WIDTHS,
+    )
+    strat = build_strategy(cfg)
+    model = UNet(dtype=jnp.float32, widths=WIDTHS)
+    params = model.init(jax.random.key(0), jnp.zeros((1, H, W, 3)))["params"]
+    state, tx = create_train_state(params, 1e-4)
+    state = strat.place_state(state)
+    rng = np.random.default_rng(0)
+    batch = strat.place_batch(
+        {
+            "image": rng.random((B, H, W, 3), dtype=np.float32),
+            "mask": (rng.random((B, H, W)) > 0.5).astype(np.int32),
+        }
+    )
+    compiled = strat.build_train_step(model, tx).lower(state, batch).compile()
+    return set(_COLLECTIVE_RE.findall(compiled.as_text()))
+
+
+@pytest.mark.parametrize(
+    "method,required",
+    [
+        ("DP", {"all-reduce"}),
+        ("SP", {"collective-permute"}),  # the conv halo exchanges
+        ("FSDP", {"all-gather"}),  # param gathering (ZeRO)
+        ("MP", {"collective-permute"}),  # ppermute stage transfers
+    ],
+)
+def test_strategy_hlo_contains_collectives(method, required):
+    ops = _compiled_collectives(method)
+    assert required <= ops, f"{method}: expected {required} ⊆ {ops}"
+
+
+def test_tp_hlo_reshards_channels():
+    """TP's sharded-channel layers must communicate somehow — XLA may pick
+    all-to-all, all-gather, or permutes depending on version; any of them
+    proves channels are genuinely distributed."""
+    ops = _compiled_collectives("TP")
+    assert ops & {"all-to-all", "all-gather", "collective-permute"}, ops
