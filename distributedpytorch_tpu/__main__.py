@@ -1,0 +1,7 @@
+"""``python -m distributedpytorch_tpu`` → the training CLI (same surface
+as ``train.py`` / the ``dpt-train`` console script)."""
+
+from distributedpytorch_tpu.cli import main
+
+if __name__ == "__main__":
+    main()

@@ -25,8 +25,12 @@ from distributedpytorch_tpu.models.unet import UNet
 from distributedpytorch_tpu.parallel import build_strategy
 from distributedpytorch_tpu.train.steps import create_train_state
 
-H, W, B = 32, 48, 8
-WIDTHS = (8, 16)
+# Single source for the tiny-rig shapes: drift between the numerics suite
+# and this compiler-level suite would silently test different programs.
+# Construction stays per-test (not shared fixtures): the compiled step
+# donates its state, so reusing one placed state across tests would hand
+# later tests deleted buffers.
+from tests.test_strategies import B, H, W, WIDTHS  # noqa: E402
 
 _COLLECTIVE_RE = re.compile(
     r"(all-reduce|all-gather|reduce-scatter|collective-permute|all-to-all)"
