@@ -44,9 +44,10 @@ MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 20))
 # latency (~50 ms measured here) otherwise dominates the ~chip-time step.
 FUSED_STEPS = 10
 
-# Analytic per-image FLOPs (fallback when XLA cost analysis is unavailable):
-# forward = sum of 2·K²·Cin·Cout·Hout·Wout over every conv/deconv in the
-# 4-level UNet at 640×960 ≈ 0.257 TFLOP; backward ≈ 2× forward.
+# Analytic per-image LOGICAL (pixel-domain) FLOPs at 640×960: forward = sum
+# of 2·K²·Cin·Cout·Hout·Wout over every conv/deconv in the 4-level UNet
+# ≈ 0.257 TFLOP; backward ≈ 2× forward. Scales linearly in H·W (every conv's
+# spatial extent does), which run() uses for non-default BENCH_H/BENCH_W.
 ANALYTIC_FWD_FLOPS_PER_IMG = 0.257e12
 ANALYTIC_STEP_FLOPS_PER_IMG = 3.0 * ANALYTIC_FWD_FLOPS_PER_IMG
 
@@ -126,11 +127,20 @@ def run() -> dict:
         .lower(state, stacked)
         .compile()
     )
-    flops_per_step = xla_step_flops(compiled)
+    # Executed FLOPs (XLA cost analysis of the compiled step). With the
+    # default space-to-depth execution mode this EXCEEDS the model's logical
+    # FLOPs — the structured dense kernels multiply by zeros the MXU schedule
+    # anyway — so MFU is defined on the logical (pixel-domain) count and the
+    # executed count is reported separately as hardware utilization. The
+    # logical count comes from ONE source in every mode — the analytic conv
+    # sum, which scales linearly with H·W — so MFU ratios between execution
+    # modes always track measured imgs/sec ratios.
+    flops_executed = xla_step_flops(compiled)
     flops_source = "xla_cost_analysis"
-    if flops_per_step <= 0:
-        flops_per_step = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH
+    if flops_executed <= 0:
+        flops_executed = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
         flops_source = "analytic"
+    flops_logical = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
 
     # -- unfused: one dispatch per step --------------------------------------
     for _ in range(WARMUP_STEPS):
@@ -163,7 +173,6 @@ def run() -> dict:
 
     per_step = min(fused_per_step, unfused_per_step)
     imgs_per_sec = BATCH / per_step
-    achieved_flops = flops_per_step / per_step
     peak = chip_peak_flops(dev)
     return {
         "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
@@ -173,10 +182,17 @@ def run() -> dict:
         "step_time_ms": round(1e3 * per_step, 2),
         "steps_per_dispatch": FUSED_STEPS if per_step == fused_per_step else 1,
         "imgs_per_sec_single_dispatch": round(BATCH / unfused_per_step, 2),
-        "flops_per_img": round(flops_per_step / BATCH / 1e9, 2),  # GFLOP
+        # logical = pixel-domain model FLOPs (the work a user asked for);
+        # executed = what the compiled s2d computation runs (incl. its
+        # structural zeros). MFU uses logical; hw_utilization uses executed.
+        "flops_per_img": round(flops_logical / BATCH / 1e9, 2),  # GFLOP
+        "flops_per_img_executed": round(flops_executed / BATCH / 1e9, 2),
         "flops_source": flops_source,
-        "achieved_tflops": round(achieved_flops / 1e12, 2),
-        "mfu": round(achieved_flops / peak, 4) if peak > 0 else None,
+        "achieved_tflops": round(flops_executed / per_step / 1e12, 2),
+        "mfu": round(flops_logical / per_step / peak, 4) if peak > 0 else None,
+        "hw_utilization": (
+            round(flops_executed / per_step / peak, 4) if peak > 0 else None
+        ),
         "device_kind": getattr(dev, "device_kind", dev.platform),
     }
 

@@ -64,6 +64,11 @@ def get_args():
                              "(~half HBM, ~1/3 more FLOPs)")
     parser.add_argument("--pallas", action="store_true",
                         help="Use the fused Pallas loss-stats kernel for eval")
+    parser.add_argument("--s2d-levels", type=int, default=-1,
+                        help="Shallow UNet levels executed in the "
+                             "space-to-depth domain (exact numerics, ~1.9x "
+                             "faster on TPU); 0 disables, -1 = auto "
+                             "(2 on TPU, 0 elsewhere)")
     parser.add_argument("--model-widths", type=int, nargs="+", default=None,
                         help="Encoder channel widths (default 32 64 128 256, "
                              "the reference model; e.g. 64 128 256 512 for a "
@@ -123,6 +128,7 @@ def main():
         remat=args.remat,
         use_pallas=args.pallas,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
+        s2d_levels=args.s2d_levels,
         checkpoint_name=args.checkpoint or (args.load if args.load else None),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,

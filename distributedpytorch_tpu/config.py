@@ -60,6 +60,13 @@ class TrainConfig:
     # None = the reference channel plan (32,64,128,256 / mid 512, 7.76M
     # params). Narrower tuples build faster-compiling variants for tests.
     model_widths: Optional[Tuple[int, ...]] = None
+    # Shallow levels executed in the space-to-depth domain (ops/s2d.py):
+    # exactly equivalent numerics, measured ~1.9× step-time win on TPU v5e at
+    # the reference config (the full-res C=32/64 convs starve the 128-lane
+    # MXU; their s2d forms don't). -1 = auto: 2 on a TPU backend, 0 elsewhere
+    # (the rewrite's 4× nominal MACs only pay off on the MXU).
+    # 0 = plain pixel-domain execution.
+    s2d_levels: int = -1
 
     @property
     def model_levels(self) -> int:

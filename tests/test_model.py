@@ -86,7 +86,7 @@ def test_gradients_flow(small_unet):
         y = model.apply({"params": p}, x)
         return jnp.mean((y - t) ** 2)
 
-    grads = jax.grad(loss_fn)(params)
+    grads = jax.jit(jax.grad(loss_fn))(params)
     norms = [float(jnp.linalg.norm(g)) for g in jax.tree.leaves(grads)]
     assert all(n == n for n in norms)  # no NaNs
     assert sum(norms) > 0

@@ -63,7 +63,7 @@ def _ref_loss_and_grads(model, params, batch):
         target = jnp.asarray(batch["mask"])[..., None].astype(jnp.float32)
         return bce_dice_loss(preds, target)
 
-    return jax.value_and_grad(loss_fn)(params)
+    return jax.jit(jax.value_and_grad(loss_fn))(params)
 
 
 def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
@@ -85,51 +85,88 @@ def _config(method, **kw):
 
 
 class TestPipelineNumerics:
-    def test_pipeline_loss_and_grads_match_plain(self, model, params, batch):
+    """The GPipe schedule's loss/grad equivalence. These use a 1-level UNet
+    at 16×24 — the schedule (stage masking, ppermute chains, microbatch
+    statistics, its transpose under autodiff) is depth-independent, and the
+    differentiated shard_map scan is by far the suite's most expensive
+    compile: the 2-level 32×48 variant of the grad test alone cost 108 s of
+    single-core XLA time."""
+
+    P_WIDTHS = (8,)
+    PH, PW = 16, 24
+
+    @pytest.fixture(scope="class")
+    def pmodel(self):
+        return UNet(dtype=jnp.float32, widths=self.P_WIDTHS)
+
+    @pytest.fixture(scope="class")
+    def pparams(self, pmodel):
+        return pmodel.init(jax.random.key(0), jnp.zeros((1, self.PH, self.PW, 3)))[
+            "params"
+        ]
+
+    @pytest.fixture(scope="class")
+    def pbatch(self):
+        rng = np.random.default_rng(0)
+        return {
+            "image": rng.random((B, self.PH, self.PW, 3), dtype=np.float32),
+            "mask": (rng.random((B, self.PH, self.PW)) > 0.5).astype(np.int32),
+        }
+
+    def _pconfig(self, method, **kw):
+        return TrainConfig(
+            train_method=method,
+            batch_size=B,
+            compute_dtype="float32",
+            image_size=(self.PW, self.PH),
+            model_widths=self.P_WIDTHS,
+            **kw,
+        )
+
+    def test_pipeline_loss_and_grads_match_plain(self, pmodel, pparams, pbatch):
         """Loss AND grads in one value_and_grad — one XLA compile covers
         both equivalence claims (separate tests each paid the full compile
         of the pipelined backward, the old suite's single slowest item)."""
-        cfg = _config("MP")
-        strat = build_strategy(cfg)
-        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
-        ref_loss, ref_grads = _ref_loss_and_grads(model, params, batch)
-        pipe_loss, pipe_grads = jax.value_and_grad(
-            lambda p: loss_fn(p, _prep(batch))
-        )(params)
+        strat = build_strategy(self._pconfig("MP"))
+        loss_fn = make_pipeline_loss_fn(pmodel, strat.mesh, num_microbatches=2)
+        ref_loss, ref_grads = _ref_loss_and_grads(pmodel, pparams, pbatch)
+        prepped = _prep(pbatch)
+        pipe_loss, pipe_grads = jax.jit(
+            jax.value_and_grad(lambda p: loss_fn(p, prepped))
+        )(pparams)
         np.testing.assert_allclose(
             float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6
         )
         _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
 
-    def test_pipeline_forward_matches_plain(self, model, params, batch):
-        cfg = _config("MP")
-        strat = build_strategy(cfg)
-        fwd = make_pipeline_forward_fn(model, strat.mesh, num_microbatches=2)
-        ref = model.apply({"params": params}, jnp.asarray(batch["image"]))
-        out = fwd(params, jnp.asarray(batch["image"]))
+    def test_pipeline_forward_matches_plain(self, pmodel, pparams, pbatch):
+        strat = build_strategy(self._pconfig("MP"))
+        fwd = make_pipeline_forward_fn(pmodel, strat.mesh, num_microbatches=2)
+        ref = pmodel.apply({"params": pparams}, jnp.asarray(pbatch["image"]))
+        out = jax.jit(fwd)(pparams, jnp.asarray(pbatch["image"]))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
 
-    def test_four_microbatches(self, model, params, batch):
-        cfg = _config("MP", num_microbatches=4)
-        strat = build_strategy(cfg)
-        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=4)
-        ref_loss, _ = _ref_loss_and_grads(model, params, batch)
+    def test_four_microbatches(self, pmodel, pparams, pbatch):
+        strat = build_strategy(self._pconfig("MP", num_microbatches=4))
+        loss_fn = make_pipeline_loss_fn(pmodel, strat.mesh, num_microbatches=4)
+        ref_loss, _ = _ref_loss_and_grads(pmodel, pparams, pbatch)
+        prepped = _prep(pbatch)
         np.testing.assert_allclose(
-            float(loss_fn(params, _prep(batch))), float(ref_loss), rtol=1e-5, atol=1e-6
+            float(jax.jit(loss_fn)(pparams, prepped)), float(ref_loss),
+            rtol=1e-5, atol=1e-6,
         )
 
-    def test_hybrid_loss_and_grads(self, model, params, batch):
-        cfg = _config("DDP_MP")
-        strat = build_strategy(cfg)
+    def test_hybrid_loss_and_grads(self, pmodel, pparams, pbatch):
+        strat = build_strategy(self._pconfig("DDP_MP"))
         assert dict(strat.mesh.shape) == {"data": 4, "stage": 2}
         loss_fn = make_pipeline_loss_fn(
-            model, strat.mesh, num_microbatches=2, data_axis="data"
+            pmodel, strat.mesh, num_microbatches=2, data_axis="data"
         )
-        ref_loss, ref_grads = _ref_loss_and_grads(model, params, batch)
-        prepped = _prep(batch)
+        ref_loss, ref_grads = _ref_loss_and_grads(pmodel, pparams, pbatch)
+        prepped = _prep(pbatch)
         pipe_loss, pipe_grads = jax.jit(
             jax.value_and_grad(lambda p: loss_fn(p, prepped))
-        )(params)
+        )(pparams)
         np.testing.assert_allclose(float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6)
         _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
 

@@ -94,9 +94,15 @@ def test_resume_roundtrip(tmp_path):
 
 def _compare_k_dispatch(tmp_path, method, **kw):
     """Train (method, K=1) vs (method, K=2) on identical data; per-step loss
-    records and final params must match exactly."""
+    records and final params must match exactly. A 1-level UNet: the fused
+    dispatch machinery under test (stacked-batch scan, leftover buffer,
+    ragged-tail fallback) is model-independent, and each call compiles
+    2×(train+eval) steps."""
     import jax
     import pandas as pd
+
+    kw.setdefault("model_widths", (8,))
+    kw.setdefault("image_size", (16, 16))
 
     r1 = Trainer(_config(tmp_path / "a", method=method, **kw)).train()
     t2 = Trainer(_config(tmp_path / "b", method=method, steps_per_dispatch=2, **kw))

@@ -8,8 +8,14 @@ Usage:  python tools/bench_loader.py [--n 64] [--size 960 640] [--batch 8]
 
 import argparse
 import json
+import os
+import sys
 import tempfile
 import time
+
+# Standalone-runnable: `python tools/bench_loader.py` puts tools/ (not the
+# repo root) on sys.path, so locate the package relative to this file.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():

@@ -15,7 +15,12 @@ Usage:  python tools/model_summary.py [--measured] [-o MODEL.md]
 """
 
 import argparse
+import os
 import sys
+
+# Standalone-runnable: `python tools/model_summary.py` puts tools/ (not the
+# repo root) on sys.path, so locate the package relative to this file.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -126,7 +131,9 @@ def main():
     from distributedpytorch_tpu.models.unet import UNet, init_unet_params
 
     model = UNet(dtype=jnp.bfloat16)
-    params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
+    # params are input-size-independent: init at the smallest legal spatial
+    # size (the full 640×960 init costs ~30 s of CPU XLA compile for nothing)
+    params = init_unet_params(model, jax.random.key(0), input_hw=(16, 16))
     mods, total = param_census(params)
     act_rows, act_total = activation_table()
 
