@@ -47,6 +47,10 @@ class TrainConfig:
     # preprocess reads it as (newW, newH) (dataloading.py:29).
     image_size: Tuple[int, int] = (960, 640)
     num_workers: int = 0  # host-side prefetch threads (0 = synchronous)
+    # Device-placement prefetch depth: host→device transfer of batch i+1..i+k
+    # overlaps the device's compute of batch i (transfers are comparable to
+    # the step time on tunneled/remote runtimes). 0 = place synchronously.
+    prefetch_batches: int = 2
 
     # -- pipeline (MP) ------------------------------------------------------
     num_microbatches: int = 2  # reference hardcodes 2 (unet_model.py:25)

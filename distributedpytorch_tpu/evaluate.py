@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Callable, Tuple
 
+import jax
 import numpy as np
 
 
@@ -37,12 +38,24 @@ def evaluate(
             batches, total=len(loader), desc="Validation round",
             unit="batch", leave=False,
         )
+    # Keep device scalars and pull them in chunks — a float() per batch is a
+    # blocking device→host round trip per metric (measured ~1.1 s/val-batch
+    # over a tunneled runtime), while NO sync at all lets the host place the
+    # entire val set's input buffers on the device before the first eval
+    # step retires (gigabytes of live HBM at full resolution). A chunked
+    # device_get bounds run-ahead to CHUNK batches per transfer.
+    CHUNK = 8
     for batch in batches:
         if place_batch is not None:
             batch = place_batch(batch)
         metrics = eval_step(params, batch)
-        losses.append(float(metrics["loss"]))
-        dices.append(float(metrics["dice"]))
+        losses.append(metrics["loss"])
+        dices.append(metrics["dice"])
+        if len(losses) % CHUNK == 0:
+            losses[-CHUNK:], dices[-CHUNK:] = jax.device_get(
+                (losses[-CHUNK:], dices[-CHUNK:])
+            )
     if not losses:
         return float("nan"), float("nan")
+    losses, dices = jax.device_get((losses, dices))
     return float(np.mean(losses)), float(np.mean(dices))

@@ -23,10 +23,27 @@ import jax.numpy as jnp
 
 EPS = 1e-15  # reference utils/utils.py:13
 _LOG_CLAMP = -100.0  # torch BCELoss log clamp
+# Below this, x is treated as saturated: the value clamps to -100 and the
+# gradient is 0. Chosen so 1/x stays finite in float32 (subnormals would
+# push 1/x to inf).
+_LOG_SAFE_MIN = 1e-35
 
 
 def _clamped_log(x: jax.Array) -> jax.Array:
-    return jnp.maximum(jnp.log(x), _LOG_CLAMP)
+    """log(x) with torch.nn.BCELoss's >= -100 clamp — GRAD-SAFELY.
+
+    ``maximum(log(x), -100)`` has the right value but a NaN gradient at
+    x == 0: the max selects the constant (selector grad 0) while the log
+    branch's cotangent is 1/0 = inf, and 0 · inf = NaN. One saturated
+    sigmoid pixel (p exactly 0.0 or 1.0, which bf16 logits ≥ ~17 produce
+    in float32) then NaNs the ENTIRE gradient through the sum — observed
+    in round 3 as a real TPU training run diverging to NaN at epoch 10
+    right after val-Dice hit 0.98. The where-on-both-sides pattern keeps
+    every intermediate finite, so saturated pixels contribute the clamped
+    value and exactly zero gradient (matching torch's backward clamp in
+    effect)."""
+    safe = jnp.maximum(x, _LOG_SAFE_MIN)
+    return jnp.where(x >= _LOG_SAFE_MIN, jnp.log(safe), _LOG_CLAMP)
 
 
 def binary_cross_entropy(outputs: jax.Array, targets: jax.Array) -> jax.Array:

@@ -1,6 +1,7 @@
 """Golden tests for BCE − log-dice loss vs the reference formula
 (reference utils/utils.py:9-25), cross-checked against torch (CPU)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -81,3 +82,18 @@ def test_dice_coefficient_metric():
     assert float(
         dice_coefficient(outputs, jnp.array([[0.0, 0.0, 1.0, 1.0]]))
     ) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_gradient_finite_at_saturated_predictions():
+    """Regression: maximum(log(x), -100) has a NaN gradient at x == 0
+    (0 · inf through the max), so ONE sigmoid pixel saturating to exactly
+    0.0 or 1.0 NaN'd the entire gradient — observed as a real TPU training
+    run diverging at epoch 10 right after val-Dice hit 0.98. Saturated
+    pixels must contribute zero gradient, not NaN."""
+    outputs = jnp.array([[0.5, 1.0, 0.0, 0.9, 0.0, 1.0]])
+    targets = jnp.array([[1.0, 1.0, 0.0, 1.0, 1.0, 0.0]])
+    grads = jax.grad(lambda p: bce_dice_loss(p, targets))(outputs)
+    assert bool(jnp.isfinite(grads).all()), grads
+    # loss value keeps the torch clamp semantics (finite, includes the
+    # -100-clamped mispredicted-saturated pixels)
+    assert np.isfinite(float(bce_dice_loss(outputs, targets)))

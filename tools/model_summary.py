@@ -115,7 +115,10 @@ def measured_rows():
             )
             print(f"  measured batch {b}: {total/2**20:.0f} MB", file=sys.stderr)
         except Exception as exc:
-            out.append(dict(batch=b, error=f"{type(exc).__name__}: {str(exc)[:120]}"))
+            # single line: multi-line runtime errors would corrupt the
+            # generated markdown table
+            msg = " ".join(f"{type(exc).__name__}: {exc}".split())[:120]
+            out.append(dict(batch=b, error=msg))
             break
     return out
 
