@@ -197,7 +197,36 @@ def run() -> dict:
     }
 
 
+def _arm_watchdog(seconds: float) -> None:
+    """Emit an error JSON and hard-exit if the bench wedges.
+
+    A wedged/unreachable TPU runtime hangs INSIDE native backend-init or
+    compile calls — no exception ever fires, so without this the artifact
+    would be empty when the driver's own timeout kills us. A daemon timer
+    cannot be blocked by the GIL-released native call; it prints the JSON
+    line and _exits. Generous default: a healthy run (2 compiles + 2
+    measured windows) finishes in ~4 minutes."""
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_timeout",
+            "value": 0.0,
+            "unit": "imgs/sec",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: no result after {seconds:.0f}s "
+                     "(TPU runtime unreachable or wedged)",
+        }))
+        sys.stdout.flush()
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
+    _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", 1500)))
     try:
         result = run()
     except Exception as exc:

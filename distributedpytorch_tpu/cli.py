@@ -56,6 +56,9 @@ def get_args():
                         help="Pipeline microbatches (MP/DDP_MP); reference hardcodes 2")
     parser.add_argument("--num-workers", type=int, default=4,
                         help="Host-side decode threads")
+    parser.add_argument("--prefetch-batches", type=int, default=2,
+                        help="Batches placed on device ahead of compute "
+                             "(each pins one batch of HBM; 0 = synchronous)")
     parser.add_argument("--steps-per-dispatch", type=int, default=1,
                         help="Optimizer steps fused into one XLA dispatch "
                              "(amortizes runtime dispatch latency)")
@@ -124,6 +127,7 @@ def main():
         image_size=tuple(args.image_size),
         num_microbatches=args.microbatches,
         num_workers=args.num_workers,
+        prefetch_batches=args.prefetch_batches,
         steps_per_dispatch=args.steps_per_dispatch,
         remat=args.remat,
         use_pallas=args.pallas,

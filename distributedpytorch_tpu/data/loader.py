@@ -176,15 +176,10 @@ class DataLoader:
 
         # Pipelined prefetch: keep up to 2 whole-batch futures in flight
         # (the native path threads across items inside each batch in C++).
-        depth = 2
-        pending = [self._pool.submit(self._load_batch, s) for s in slices[:depth]]
-        next_submit = depth
-        while pending:
-            fut = pending.pop(0)
-            if next_submit < len(slices):
-                pending.append(self._pool.submit(self._load_batch, slices[next_submit]))
-                next_submit += 1
-            yield fut.result()
+        # bounded_submit cancels queued decodes if the consumer stops early.
+        from distributedpytorch_tpu.utils.prefetch import bounded_submit
+
+        yield from bounded_submit(self._pool, self._load_batch, slices, depth=2)
 
     def __iter__(self) -> Iterator[Batch]:
         return self.epoch_batches(0)

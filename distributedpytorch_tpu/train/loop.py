@@ -26,6 +26,7 @@ due, keeping steps dispatch-async the rest of the time.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import signal
@@ -43,6 +44,7 @@ from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rat
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
 from distributedpytorch_tpu.utils.metrics import LossRecords
+from distributedpytorch_tpu.utils.prefetch import bounded_prefetch
 
 logger = logging.getLogger(__name__)
 
@@ -263,40 +265,15 @@ class Trainer:
         throughput. The worker stays ``depth`` batches ahead, so transfers
         ride under the device's queued dispatches.
 
-        Bounded-futures shape (same as data/loader.py's decode prefetch): the
-        consumer owns the executor and submits at most ``depth`` placements
-        ahead, so abandoning the generator early (signal-stop break, a step
-        exception) cancels the queue instead of leaving a worker blocked on
-        a full queue pinning placed batches in device memory forever.
+        Runs on utils/prefetch.py's daemon-thread variant: device placement
+        can wedge indefinitely on an unreachable remote runtime, and a
+        non-daemon worker would then both pin placed batches in device
+        memory and block interpreter exit via concurrent.futures' atexit
+        join. The epoch loop closes the generator on early exit
+        (contextlib.closing), which stops the worker within its put-poll
+        interval.
         """
-        import collections
-        from concurrent.futures import ThreadPoolExecutor
-
-        ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="dpt-prefetch")
-        pending = collections.deque()
-        it = iter(batches)
-
-        def submit_next():
-            try:
-                b = next(it)
-            except StopIteration:
-                return False
-            pending.append((b, ex.submit(self.strategy.place_batch, b)))
-            return True
-
-        try:
-            for _ in range(max(1, depth)):
-                if not submit_next():
-                    break
-            while pending:
-                b, fut = pending.popleft()
-                placed = fut.result()
-                submit_next()
-                yield b, placed
-        finally:
-            for _, fut in pending:
-                fut.cancel()
-            ex.shutdown(wait=False)
+        return bounded_prefetch(batches, self.strategy.place_batch, depth=depth)
 
     def train(self) -> dict:
         """Run the configured epochs; signal handlers are scoped to the run
@@ -385,27 +362,34 @@ class Trainer:
                 else:
                     # the fused-dispatch path places whole K-stacks itself
                     source = ((b, None) for b in source)
-                for batch, placed in source:
-                    # mid-epoch stop is single-process only: in multi-process
-                    # runs ranks must agree (epoch boundary) or collectives
-                    # desync and hang — see _install_signal_handler
-                    if self._stop_requested and single_process:
-                        break
-                    if self.multi_step is None:
-                        run_one(batch, placed)
-                        continue
-                    # only full, uniformly-shaped batches can stack into the
-                    # scanned executable; the tail falls through to run_one
-                    if batch["image"].shape[0] == cfg.batch_size:
-                        buffer.append(batch)
-                        if len(buffer) == self.k_dispatch:
-                            run_stack(buffer)
+                # closing(): breaking out mid-epoch (signal stop) must CLOSE
+                # the prefetch generator so its worker stops and queued
+                # device-placed batches get released — GC-time cleanup would
+                # keep them pinned through the checkpoint save
+                with contextlib.closing(source):
+                    for batch, placed in source:
+                        # mid-epoch stop is single-process only: in
+                        # multi-process runs ranks must agree (epoch
+                        # boundary) or collectives desync and hang — see
+                        # _install_signal_handler
+                        if self._stop_requested and single_process:
+                            break
+                        if self.multi_step is None:
+                            run_one(batch, placed)
+                            continue
+                        # only full, uniformly-shaped batches can stack into
+                        # the scanned executable; the tail falls through to
+                        # run_one
+                        if batch["image"].shape[0] == cfg.batch_size:
+                            buffer.append(batch)
+                            if len(buffer) == self.k_dispatch:
+                                run_stack(buffer)
+                                buffer = []
+                        else:
+                            for b in buffer:
+                                run_one(b)
                             buffer = []
-                    else:
-                        for b in buffer:
-                            run_one(b)
-                        buffer = []
-                        run_one(batch)
+                            run_one(batch)
                 for b in buffer:
                     # never train buffered batches past a stop request: they
                     # were never stepped, so skipping them loses nothing, and

@@ -1,0 +1,104 @@
+"""Bounded prefetch helpers shared by the input pipeline.
+
+Two variants of the same submit-ahead/pop/yield shape, differing in who
+runs the work and what happens when the consumer walks away:
+
+* :func:`bounded_prefetch` — a single daemon worker thread. For work that
+  may block indefinitely on an external runtime (host→device placement on
+  a remote/tunneled TPU): a daemon thread can never block interpreter
+  exit, and closing the generator (or breaking out of a ``for``) stops the
+  worker within its put-poll interval instead of leaving it wedged on a
+  full queue pinning device buffers.
+* :func:`bounded_submit` — futures on a caller-owned executor. For
+  parallel host-side work (image decode across a pool); abandoning the
+  generator cancels everything still queued.
+
+Both yield in submission order and re-raise worker exceptions at the
+consumption point.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as queue_mod
+import threading
+from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+_DONE = object()
+
+
+def bounded_prefetch(
+    items: Iterable[T], fn: Callable[[T], R], depth: int = 2
+) -> Iterator[Tuple[T, R]]:
+    """Yield ``(item, fn(item))`` with ``fn`` running up to ``depth`` items
+    ahead on a daemon thread."""
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+    stop = threading.Event()
+
+    def put(payload) -> bool:
+        """Blocking put that gives up when the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(payload, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for item in items:
+                if stop.is_set():
+                    return
+                if not put((item, fn(item))):
+                    return
+        except BaseException as exc:  # re-raised at the consumption point
+            put(exc)
+            return
+        put(_DONE)
+
+    threading.Thread(target=worker, daemon=True, name="dpt-prefetch").start()
+    try:
+        while True:
+            payload = q.get()
+            if payload is _DONE:
+                return
+            if isinstance(payload, BaseException):
+                raise payload
+            yield payload
+    finally:
+        stop.set()
+
+
+def bounded_submit(
+    pool, fn: Callable[[T], R], items: Iterable[T], depth: int = 2
+) -> Iterator[R]:
+    """Yield ``fn(item)`` results in order, keeping up to ``depth`` futures
+    in flight on ``pool``; abandoning the generator cancels queued work."""
+    pending: collections.deque = collections.deque()
+    it = iter(items)
+
+    def submit_next() -> bool:
+        try:
+            item = next(it)
+        except StopIteration:
+            return False
+        pending.append(pool.submit(fn, item))
+        return True
+
+    try:
+        for _ in range(max(1, depth)):
+            if not submit_next():
+                break
+        while pending:
+            fut = pending.popleft()
+            # refill BEFORE blocking on the result: the pool keeps `depth`
+            # items genuinely in flight while the consumer waits
+            submit_next()
+            yield fut.result()
+    finally:
+        for fut in pending:
+            fut.cancel()

@@ -1,0 +1,68 @@
+"""LossRecords: reference pickle schema (reference utils/train_utils.py:75-92),
+row cadence, lazy device-loss pulls, and steady-state throughput accounting."""
+
+import os
+
+import numpy as np
+import pandas as pd
+
+from distributedpytorch_tpu.utils.metrics import LossRecords
+
+
+def test_row_cadence_and_schema(tmp_path):
+    rec = LossRecords("singleGPU", loss_dir=str(tmp_path), every=2)
+    for step in range(1, 7):
+        rec.record_train(step, float(step), batch_images=4)
+    rec.record_val(6, 0.5, val_dice=0.25)
+    rec.save()
+
+    train = pd.read_pickle(tmp_path / "singleGPU" / "train_loss.pkl")
+    assert list(train.columns) == ["Step", "Time", "Loss"]
+    assert train["Step"].tolist() == [2, 4, 6]
+    # mean of the last `every` losses per row (reference train_utils.py:78)
+    np.testing.assert_allclose(train["Loss"].tolist(), [1.5, 3.5, 5.5])
+
+    val = pd.read_pickle(tmp_path / "singleGPU" / "val_loss.pkl")
+    assert val["Loss"].tolist() == [0.5]
+    dice = pd.read_pickle(tmp_path / "singleGPU" / "val_dice.pkl")
+    assert list(dice.columns) == ["Step", "Time", "Dice"]
+    assert dice["Dice"].tolist() == [0.25]
+
+
+def test_lazy_loss_pulled_only_when_row_due(tmp_path):
+    """The multi-step dispatch path hands a zero-arg callable; it must be
+    forced only when a metrics row is due (one host sync per `every`
+    steps), never per step."""
+    pulls = []
+
+    def lazy(v):
+        def pull():
+            pulls.append(v)
+            return v
+
+        return pull
+
+    rec = LossRecords("m", loss_dir=str(tmp_path), every=3)
+    for step in range(1, 4):
+        rec.record_train(step, lazy(float(step)), batch_images=1)
+    assert pulls == [1.0, 2.0, 3.0]  # all pulled at the step-3 row, not before
+    rec.record_train(4, lazy(4.0), batch_images=1)
+    assert pulls == [1.0, 2.0, 3.0]  # step 4: no row due, nothing pulled
+
+
+def test_images_per_second_excludes_first_step(tmp_path):
+    rec = LossRecords("m", loss_dir=str(tmp_path), every=10)
+    assert rec.images_per_second() == 0.0  # nothing recorded yet
+    rec.record_train(1, 1.0, batch_images=4)  # compile step: starts the clock
+    rec.record_train(2, 1.0, batch_images=4)
+    ips = rec.images_per_second()
+    assert ips > 0.0
+    # only the post-first-step images count in the numerator
+    assert rec.images_seen - rec._steady_images0 == 4
+
+
+def test_save_creates_directories(tmp_path):
+    rec = LossRecords("DP", loss_dir=str(tmp_path / "nested" / "loss"))
+    rec.record_train(10, 1.0, batch_images=1)
+    rec.save()
+    assert os.path.isdir(tmp_path / "nested" / "loss" / "DP")

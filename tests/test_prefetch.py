@@ -1,0 +1,87 @@
+"""Bounded prefetch helpers (utils/prefetch.py): ordering, exception
+propagation, and — the load-bearing part — early-abandon cleanup, which is
+what keeps a wedged device placement from pinning buffers or blocking
+interpreter exit (train/loop.py) and cancels queued decodes (data/loader.py)."""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from distributedpytorch_tpu.utils.prefetch import bounded_prefetch, bounded_submit
+
+
+class TestBoundedPrefetch:
+    def test_order_and_results(self):
+        out = list(bounded_prefetch(range(7), lambda x: x * x, depth=2))
+        assert out == [(i, i * i) for i in range(7)]
+
+    def test_exception_propagates(self):
+        def fn(x):
+            if x == 3:
+                raise ValueError("boom")
+            return x
+
+        gen = bounded_prefetch(range(6), fn, depth=2)
+        got = []
+        with pytest.raises(ValueError, match="boom"):
+            for item, _ in gen:
+                got.append(item)
+        assert got == [0, 1, 2]
+
+    def test_early_close_stops_worker(self):
+        started = []
+        release = threading.Event()
+
+        def fn(x):
+            started.append(x)
+            release.wait(5)  # a slow placement
+            return x
+
+        gen = bounded_prefetch(range(100), fn, depth=1)
+        item, _0 = next(gen)
+        assert item == 0
+        gen.close()  # consumer walks away (signal stop)
+        release.set()
+        time.sleep(0.5)  # worker notices stop within its put-poll interval
+        # the worker ran at most the in-flight + queued items, not all 100
+        assert len(started) <= 4, started
+
+    def test_runs_ahead(self):
+        seen = []
+
+        def fn(x):
+            seen.append(x)
+            return x
+
+        gen = bounded_prefetch(range(10), fn, depth=3)
+        next(gen)
+        time.sleep(0.3)
+        # with the consumer stalled, the worker is several items ahead
+        assert len(seen) >= 3
+        gen.close()
+
+
+class TestBoundedSubmit:
+    def test_order_and_results(self):
+        with ThreadPoolExecutor(2) as pool:
+            assert list(bounded_submit(pool, lambda x: -x, range(5), depth=2)) == [
+                0, -1, -2, -3, -4,
+            ]
+
+    def test_abandon_cancels_queued(self):
+        ran = []
+        gate = threading.Event()
+
+        def fn(x):
+            gate.wait(5)
+            ran.append(x)
+            return x
+
+        with ThreadPoolExecutor(1) as pool:
+            gen = bounded_submit(pool, fn, range(50), depth=3)
+            gate.set()
+            assert next(gen) == 0
+            gen.close()  # cancels the still-queued futures
+        assert len(ran) <= 5, ran

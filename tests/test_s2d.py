@@ -183,3 +183,40 @@ class TestModelEquivalence:
         y = jax.jit(lambda p, x: m.apply({"params": p}, x))(p, x)
         assert y.shape == (1, 8, 8, 1)
         assert bool(jnp.isfinite(y).all())
+
+
+class TestS2DUnderParallelism:
+    """The s2d execution domain must compose with the parallelism machinery
+    the TPU default (s2d_levels=2) will run under. The CPU-mesh suite
+    otherwise never exercises it — the auto default resolves to 0 off-TPU."""
+
+    def test_pipeline_loss_matches_plain_with_s2d(self, devices):
+        from distributedpytorch_tpu.config import TrainConfig
+        from distributedpytorch_tpu.ops.losses import bce_dice_loss
+        from distributedpytorch_tpu.parallel import build_strategy
+        from distributedpytorch_tpu.parallel.pipeline import make_pipeline_loss_fn
+
+        H, W, B = 16, 24, 8
+        model = UNet(dtype=jnp.float32, widths=(8,), s2d_levels=1)
+        params = model.init(jax.random.key(0), jnp.zeros((1, H, W, 3)))["params"]
+        rng = np.random.default_rng(0)
+        image = jnp.asarray(rng.random((B, H, W, 3), dtype=np.float32))
+        mask = jnp.asarray(
+            (rng.random((B, H, W)) > 0.5).astype(np.float32)
+        )[..., None]
+
+        def ref_loss(p):
+            return bce_dice_loss(model.apply({"params": p}, image), mask)
+
+        cfg = TrainConfig(
+            train_method="MP", batch_size=B, compute_dtype="float32",
+            image_size=(W, H), model_widths=(8,),
+        )
+        strat = build_strategy(cfg)
+        loss_fn = make_pipeline_loss_fn(model, strat.mesh, num_microbatches=2)
+        batch = {"image": image, "mask": mask}
+        np.testing.assert_allclose(
+            float(jax.jit(loss_fn)(params, batch)),
+            float(jax.jit(ref_loss)(params)),
+            rtol=1e-5, atol=1e-6,
+        )
