@@ -34,8 +34,13 @@ def bounded_prefetch(
     items: Iterable[T], fn: Callable[[T], R], depth: int = 2
 ) -> Iterator[Tuple[T, R]]:
     """Yield ``(item, fn(item))`` with ``fn`` running up to ``depth`` items
-    ahead on a daemon thread."""
-    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth))
+    ahead on a daemon thread.
+
+    The bound counts results the worker holds: queued completions plus the
+    one a blocked ``put`` is holding total ``depth``, so at steady state
+    ``depth`` results (+ the one the consumer is using) are alive at once —
+    for device placement, that many batches of device memory."""
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth - 1))
     stop = threading.Event()
 
     def put(payload) -> bool:

@@ -54,3 +54,24 @@ def test_model_summary_cpu_mode(tmp_path):
     text = out.read_text()
     assert "7,760,097" in text  # the golden param count
     assert "29.60 MB" in text  # parity with reference modelsummary.txt:69
+
+
+def test_plot_img_and_mask(tmp_path):
+    """The reference's plot_img_and_mask (reference utils/utils.py:38-51)
+    rebuilt headless: renders image + per-class mask panels to a PNG."""
+    import numpy as np
+
+    from distributedpytorch_tpu.utils.plotting import plot_img_and_mask
+
+    rng = np.random.default_rng(0)
+    img = rng.random((32, 48, 3), dtype=np.float32)
+    mask = (rng.random((32, 48)) > 0.5).astype(np.int32)
+    out = tmp_path / "panel.png"
+    plot_img_and_mask(img, mask, out_path=str(out))
+    assert out.stat().st_size > 1000
+
+    # multi-class path: one panel per channel
+    mask3 = (rng.random((32, 48, 3)) > 0.5).astype(np.int32)
+    out3 = tmp_path / "panel3.png"
+    plot_img_and_mask(img, mask3, out_path=str(out3))
+    assert out3.stat().st_size > 1000
