@@ -45,6 +45,7 @@ def save_checkpoint(
     scheduler_state: Optional[dict] = None,
     step: int = 0,
     epoch: int = 0,
+    records_state: Optional[dict] = None,
 ) -> None:
     payload = {
         "version": CKPT_VERSION,
@@ -55,6 +56,10 @@ def save_checkpoint(
         "scheduler": scheduler_state,
         "step": int(step),
         "epoch": int(epoch),
+        # metric history (LossRecords.state_dict): a resumed run must append
+        # to the run's loss curves, not overwrite the pickles with only its
+        # post-resume rows
+        "records": records_state,
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     blob = flax.serialization.msgpack_serialize(payload)
@@ -104,8 +109,9 @@ def load_checkpoint(
 ) -> Dict[str, Any]:
     """Restore a checkpoint into the given target structures.
 
-    Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch'}``;
-    `opt_state` is None when the checkpoint predates it or no target given.
+    Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch',
+    'records'}``; `opt_state` is None when the checkpoint predates it or no
+    target given, `records` (metric history) likewise.
     """
     with open(path, "rb") as f:
         payload = flax.serialization.msgpack_restore(f.read())
@@ -115,6 +121,7 @@ def load_checkpoint(
         "scheduler": payload.get("scheduler"),
         "step": int(payload.get("step", 0)),
         "epoch": int(payload.get("epoch", 0)),
+        "records": payload.get("records"),
     }
     if payload.get("opt_state") is not None and opt_state_target is not None:
         out["opt_state"] = flax.serialization.from_state_dict(

@@ -78,6 +78,11 @@ def get_args():
                              "4x wider ~31M-param variant)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="Capture a jax.profiler trace here")
+    parser.add_argument("--max-restarts", type=int, default=0,
+                        help="On a crash, resume from the newest epoch "
+                             "checkpoint up to N times (single-process "
+                             "runs; multi-process restarts belong to the "
+                             "launcher)")
     parser.add_argument("--export-pth", action="store_true",
                         help="Also export final weights as a reference-format .pth")
     return parser.parse_args()
@@ -151,10 +156,17 @@ def main():
     logging.basicConfig(level=logging.INFO, format="%(message)s", handlers=handlers)
     logging.info("UNet for Carvana Image Masking (Segmentation)")
 
-    trainer = Trainer(config)
     try:
-        result = trainer.train()
-        if args.export_pth and trainer.strategy.is_main:
+        if args.max_restarts > 0:
+            from distributedpytorch_tpu.train import fit_with_restarts
+
+            result, trainer = fit_with_restarts(
+                config, max_restarts=args.max_restarts, return_trainer=True
+            )
+        else:
+            trainer = Trainer(config)
+            result = trainer.train()
+        if args.export_pth and runtime.is_main:
             from distributedpytorch_tpu.checkpoint import export_reference_pth
 
             export_reference_pth(

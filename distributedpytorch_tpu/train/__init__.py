@@ -13,4 +13,8 @@ from distributedpytorch_tpu.train.steps import (  # noqa: F401
     make_eval_step,
     make_train_step,
 )
-from distributedpytorch_tpu.train.loop import Trainer, fit  # noqa: F401
+from distributedpytorch_tpu.train.loop import (  # noqa: F401
+    Trainer,
+    fit,
+    fit_with_restarts,
+)

@@ -64,6 +64,35 @@ class LossRecords:
             self.losses[-self.every :] = window
             self.train_rows.append([step, time.time() - self.start_time, float(np.mean(window))])
 
+    def state_dict(self) -> dict:
+        """Serializable metric history for checkpointing (msgpack-plain:
+        nested lists and numbers only). Pending lazy losses are forced —
+        the checkpoint must not hold device references."""
+        window = [float(x() if callable(x) else x) for x in self.losses]
+        self.losses[:] = window
+        return {
+            "train_rows": [list(map(float, r)) for r in self.train_rows],
+            "val_rows": [list(map(float, r)) for r in self.val_rows],
+            "dice_rows": [list(map(float, r)) for r in self.dice_rows],
+            "images_seen": int(self.images_seen),
+            "elapsed": float(self.elapsed),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Resume metric history: rows append after the restored ones and
+        the Time column stays monotonic (start_time is shifted so restored
+        elapsed time is accounted for)."""
+        self.train_rows = [[int(r[0]), float(r[1]), float(r[2])] for r in state["train_rows"]]
+        self.val_rows = [[int(r[0]), float(r[1]), float(r[2])] for r in state["val_rows"]]
+        self.dice_rows = [[int(r[0]), float(r[1]), float(r[2])] for r in state["dice_rows"]]
+        self.images_seen = int(state["images_seen"])
+        self.start_time = time.time() - float(state["elapsed"])
+        self.losses = []
+        # throughput clock restarts at the resumed run's first step (its
+        # compile is excluded just like a fresh run's)
+        self._steady_t0 = None
+        self._steady_images0 = 0
+
     def record_val(self, step: int, val_loss: float, val_dice: Optional[float] = None) -> None:
         now = time.time() - self.start_time
         self.val_rows.append([step, now, float(val_loss)])

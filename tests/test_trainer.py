@@ -188,3 +188,78 @@ def test_strategies_agree_on_first_losses(tmp_path):
         records[method] = df["Loss"].to_numpy()
     np.testing.assert_allclose(records["singleGPU"], records["DP"], rtol=1e-4)
     np.testing.assert_allclose(records["singleGPU"], records["MP"], rtol=1e-4)
+
+
+def test_fit_with_restarts_resumes_after_crash(tmp_path, monkeypatch):
+    """Crash recovery the reference lacks (SURVEY.md §5): a mid-run
+    exception restarts from the newest epoch checkpoint and finishes the
+    configured epochs; a second crash beyond max_restarts propagates."""
+    from distributedpytorch_tpu.train import Trainer as RealTrainer
+    from distributedpytorch_tpu.train import fit_with_restarts
+    import distributedpytorch_tpu.train.loop as loop_mod
+
+    cfg = _config(tmp_path, epochs=4, model_widths=(8,), image_size=(16, 16))
+    crashes = {"left": 1}
+
+    orig_train = RealTrainer.train
+
+    def crashy_train(self):
+        orig = self._save
+
+        def save_then_maybe_crash(epoch):
+            orig(epoch)
+            hit = crashes.get("every_save") or epoch == 2
+            if hit and crashes["left"] > 0:
+                crashes["left"] -= 1
+                raise RuntimeError("injected crash after epoch checkpoint")
+
+        self._save = save_then_maybe_crash
+        return orig_train(self)
+
+    monkeypatch.setattr(loop_mod.Trainer, "train", crashy_train)
+
+    result = fit_with_restarts(cfg, max_restarts=2)
+    assert crashes["left"] == 0  # the crash fired
+    # 4 epochs completed despite the crash: epochs 3-4 ran in the resumed
+    # trainer (3 steps/epoch at 24 train samples, batch 8)
+    assert result["steps"] == 12
+    assert np.isfinite(result["val_loss"])
+    # metric history survived the restart: the pickles hold the WHOLE run
+    # (one val row per completed epoch), not just the post-resume rows
+    import pandas as pd
+
+    val_df = pd.read_pickle(tmp_path / "loss" / "singleGPU" / "val_loss.pkl")
+    assert len(val_df) == 4, val_df
+    assert val_df["Time"].is_monotonic_increasing
+
+    # exhausted budget: with a crash at EVERY epoch save, attempt 2 (the
+    # one restart allowed) crashes again and must propagate
+    crashes["left"] = 10
+    crashes["every_save"] = True
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="injected crash"):
+        fit_with_restarts(_config(tmp_path / "b", epochs=4, model_widths=(8,),
+                                  image_size=(16, 16)), max_restarts=1)
+    assert crashes["left"] == 8  # initial attempt + exactly one restart ran
+
+
+def test_fit_with_restarts_ignores_stale_checkpoint(tmp_path, monkeypatch):
+    """A checkpoint left by a PREVIOUS invocation must not be resumed: a
+    fresh run crashing before its first save would otherwise 'succeed'
+    instantly off the stale file with no training at all."""
+    from distributedpytorch_tpu.train import fit_with_restarts
+    import distributedpytorch_tpu.train.loop as loop_mod
+
+    cfg = _config(tmp_path, epochs=2, model_widths=(8,), image_size=(16, 16))
+    Trainer(cfg).train()  # leaves ./checkpoints/singleGPU.ckpt behind
+    assert os.path.exists(tmp_path / "checkpoints" / "singleGPU.ckpt")
+
+    def crash_immediately(self):
+        raise RuntimeError("crash before any save")
+
+    monkeypatch.setattr(loop_mod.Trainer, "train", crash_immediately)
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="crash before any save"):
+        fit_with_restarts(cfg, max_restarts=5)
