@@ -497,32 +497,38 @@ def fit_with_restarts(
     e.g. for exporting final weights).
     """
     import dataclasses
-    import time as time_mod
 
     resumable = os.path.join(config.checkpoint_dir, f"{config.method_tag}.ckpt")
-    run_started = time_mod.time()
     attempt = 0
+    saved_this_run = False
     while True:
         trainer = Trainer(config, dataset=dataset, strategy=strategy)
+        if attempt > 0 and trainer.start_epoch >= config.epochs:
+            # the crash happened AFTER training completed (final checkpoint
+            # written, then e.g. records.save() failed); a "restart" would
+            # run zero epochs and report NaN metrics as success — surface
+            # the real error instead
+            raise last_exc
         try:
             result = trainer.train()
             return (result, trainer) if return_trainer else result
         except KeyboardInterrupt:
             raise
-        except Exception:
-            import jax as _jax
-
-            wrote_checkpoint = (
-                os.path.exists(resumable)
-                and os.path.getmtime(resumable) >= run_started
+        except Exception as exc:
+            # clock-free freshness: _last_saved_epoch is set iff THIS
+            # attempt actually wrote the checkpoint (mtime-vs-time.time()
+            # comparisons break on skewed/coarse filesystem clocks)
+            saved_this_run = saved_this_run or (
+                getattr(trainer, "_last_saved_epoch", None) is not None
             )
             if (
                 attempt >= max_restarts
-                or _jax.process_count() > 1
-                or not wrote_checkpoint
+                or jax.process_count() > 1
+                or not saved_this_run
             ):
                 raise
             attempt += 1
+            last_exc = exc
             logger.exception(
                 "Training crashed; restart %d/%d from %s",
                 attempt,

@@ -74,6 +74,10 @@ class LossRecords:
             "train_rows": [list(map(float, r)) for r in self.train_rows],
             "val_rows": [list(map(float, r)) for r in self.val_rows],
             "dice_rows": [list(map(float, r)) for r in self.dice_rows],
+            # sub-window losses recorded since the last row: without them a
+            # resume would under-fill the next mean-of-last-N row and drop
+            # those steps from the curve entirely
+            "window": window[-self.every :],
             "images_seen": int(self.images_seen),
             "elapsed": float(self.elapsed),
         }
@@ -87,7 +91,7 @@ class LossRecords:
         self.dice_rows = [[int(r[0]), float(r[1]), float(r[2])] for r in state["dice_rows"]]
         self.images_seen = int(state["images_seen"])
         self.start_time = time.time() - float(state["elapsed"])
-        self.losses = []
+        self.losses = [float(x) for x in state.get("window") or []]
         # throughput clock restarts at the resumed run's first step (its
         # compile is excluded just like a fresh run's)
         self._steady_t0 = None

@@ -66,3 +66,23 @@ def test_save_creates_directories(tmp_path):
     rec.record_train(10, 1.0, batch_images=1)
     rec.save()
     assert os.path.isdir(tmp_path / "nested" / "loss" / "DP")
+
+
+def test_state_dict_roundtrip_preserves_window(tmp_path):
+    """Checkpoint/resume must carry the sub-window losses recorded since
+    the last row — dropping them would under-fill the next mean-of-last-N
+    row and erase those steps from the curve."""
+    rec = LossRecords("m", loss_dir=str(tmp_path), every=4)
+    for step in range(1, 7):  # rows at 4; steps 5-6 pending in the window
+        rec.record_train(step, float(step), batch_images=1)
+    state = rec.state_dict()
+    assert state["window"] == [3.0, 4.0, 5.0, 6.0]  # last `every` losses
+
+    rec2 = LossRecords("m", loss_dir=str(tmp_path), every=4)
+    rec2.load_state_dict(state)
+    rec2.record_train(7, 7.0, batch_images=1)
+    rec2.record_train(8, 8.0, batch_images=1)
+    # row at step 8 averages steps 5-8 — identical to an uninterrupted run
+    assert rec2.train_rows[-1][0] == 8
+    np.testing.assert_allclose(rec2.train_rows[-1][2], np.mean([5, 6, 7, 8]))
+    assert rec2.elapsed >= state["elapsed"]

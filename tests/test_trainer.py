@@ -263,3 +263,23 @@ def test_fit_with_restarts_ignores_stale_checkpoint(tmp_path, monkeypatch):
 
     with _pytest.raises(RuntimeError, match="crash before any save"):
         fit_with_restarts(cfg, max_restarts=5)
+
+
+def test_fit_with_restarts_surfaces_post_training_crash(tmp_path, monkeypatch):
+    """A crash AFTER the final epoch checkpoint (e.g. records.save hitting
+    a full disk) must surface, not be 'recovered' by a zero-epoch restart
+    reporting NaN metrics as success."""
+    from distributedpytorch_tpu.train import fit_with_restarts
+    from distributedpytorch_tpu.utils.metrics import LossRecords
+
+    def bad_save(self):
+        raise OSError("disk full while writing loss pickles")
+
+    monkeypatch.setattr(LossRecords, "save", bad_save)
+    import pytest as _pytest
+
+    with _pytest.raises(OSError, match="disk full"):
+        fit_with_restarts(
+            _config(tmp_path, epochs=2, model_widths=(8,), image_size=(16, 16)),
+            max_restarts=3,
+        )
