@@ -46,6 +46,7 @@ def save_checkpoint(
     step: int = 0,
     epoch: int = 0,
     records_state: Optional[dict] = None,
+    model_state=None,
 ) -> None:
     payload = {
         "version": CKPT_VERSION,
@@ -60,6 +61,11 @@ def save_checkpoint(
         # to the run's loss curves, not overwrite the pickles with only its
         # post-resume rows
         "records": records_state,
+        # non-trainable model collections (BatchNorm running stats) for
+        # stateful models; None otherwise
+        "model_state": flax.serialization.to_state_dict(_to_host(model_state))
+        if model_state is not None
+        else None,
     }
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     blob = flax.serialization.msgpack_serialize(payload)
@@ -105,13 +111,14 @@ def load_weights(path: str, params_template):
 
 
 def load_checkpoint(
-    path: str, params_target, opt_state_target=None
+    path: str, params_target, opt_state_target=None, model_state_target=None
 ) -> Dict[str, Any]:
     """Restore a checkpoint into the given target structures.
 
     Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch',
-    'records'}``; `opt_state` is None when the checkpoint predates it or no
-    target given, `records` (metric history) likewise.
+    'records', 'model_state'}``; `opt_state` is None when the checkpoint
+    predates it or no target given, `records` (metric history) and
+    `model_state` (BatchNorm stats) likewise.
     """
     with open(path, "rb") as f:
         payload = flax.serialization.msgpack_restore(f.read())
@@ -122,10 +129,15 @@ def load_checkpoint(
         "step": int(payload.get("step", 0)),
         "epoch": int(payload.get("epoch", 0)),
         "records": payload.get("records"),
+        "model_state": None,
     }
     if payload.get("opt_state") is not None and opt_state_target is not None:
         out["opt_state"] = flax.serialization.from_state_dict(
             opt_state_target, payload["opt_state"]
+        )
+    if payload.get("model_state") is not None and model_state_target is not None:
+        out["model_state"] = flax.serialization.from_state_dict(
+            model_state_target, payload["model_state"]
         )
     return out
 

@@ -72,6 +72,11 @@ def get_args():
                              "space-to-depth domain (exact numerics, ~1.9x "
                              "faster on TPU); 0 disables, -1 = auto "
                              "(2 on TPU, 0 elsewhere)")
+    parser.add_argument("--model", dest="model_arch", type=str, default="unet",
+                        choices=["unet", "milesial"],
+                        help="Model family: the reference course UNet "
+                             "(7.76M params) or the original "
+                             "milesial/Pytorch-UNet (31M params, BatchNorm)")
     parser.add_argument("--model-widths", type=int, nargs="+", default=None,
                         help="Encoder channel widths (default 32 64 128 256, "
                              "the reference model; e.g. 64 128 256 512 for a "
@@ -136,6 +141,7 @@ def main():
         steps_per_dispatch=args.steps_per_dispatch,
         remat=args.remat,
         use_pallas=args.pallas,
+        model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
         s2d_levels=args.s2d_levels,
         checkpoint_name=args.checkpoint or (args.load if args.load else None),

@@ -61,8 +61,13 @@ class TrainConfig:
     compute_dtype: str = "bfloat16"
 
     # -- model --------------------------------------------------------------
-    # None = the reference channel plan (32,64,128,256 / mid 512, 7.76M
-    # params). Narrower tuples build faster-compiling variants for tests.
+    # "unet" = the reference course model (7,760,097 params); "milesial" =
+    # the original milesial/Pytorch-UNet it derives from (31,037,698 params
+    # at n_classes=2; BatchNorm → stateful training, SyncBN-by-construction
+    # under data-parallel meshes; reference model/modelsummary.txt:150-247).
+    model_arch: str = "unet"
+    # None = the architecture's documented channel plan. Narrower tuples
+    # build faster-compiling variants for tests.
     model_widths: Optional[Tuple[int, ...]] = None
     # Shallow levels executed in the space-to-depth domain (ops/s2d.py):
     # exactly equivalent numerics, measured ~1.9× step-time win on TPU v5e at
@@ -74,6 +79,13 @@ class TrainConfig:
 
     @property
     def model_levels(self) -> int:
+        """Number of 2× downsamplings — what spatial strategies divide H by.
+
+        unet: one pool per width entry. milesial: the first width is the
+        stem (inc) — pools = len(widths) − 1."""
+        if self.model_arch == "milesial":
+            n = len(self.model_widths) if self.model_widths else 5
+            return n - 1
         return len(self.model_widths) if self.model_widths else 4
 
     # -- artifacts (paths mirror the reference layout, §1 layer map) --------

@@ -1,1 +1,39 @@
 from distributedpytorch_tpu.models.unet import UNet, ConvBlock, Encoder, Decoder  # noqa: F401
+from distributedpytorch_tpu.models.milesial import MilesialUNet  # noqa: F401
+
+
+def create_model(config):
+    """Model factory: TrainConfig.model_arch → (model, init_fn).
+
+    ``init_fn(rng, input_hw) -> (params, model_state_or_None)`` — stateful
+    models (milesial's BatchNorm) return their non-trainable collections as
+    the second element.
+    """
+    import jax.numpy as jnp
+
+    arch = getattr(config, "model_arch", "unet")
+    if arch == "unet":
+        from distributedpytorch_tpu.models.unet import create_unet, init_unet_params
+
+        model = create_unet(config)
+
+        def init_fn(rng, input_hw):
+            return init_unet_params(model, rng, input_hw=input_hw), None
+
+        return model, init_fn
+    if arch == "milesial":
+        from distributedpytorch_tpu.models.milesial import (
+            MILESIAL_WIDTHS,
+            init_milesial,
+        )
+
+        widths = tuple(config.model_widths) if config.model_widths else MILESIAL_WIDTHS
+        model = MilesialUNet(
+            widths=widths, dtype=jnp.dtype(config.compute_dtype)
+        )
+
+        def init_fn(rng, input_hw):
+            return init_milesial(model, rng, input_hw=input_hw)
+
+        return model, init_fn
+    raise ValueError(f"unknown model_arch {arch!r} (expected 'unet' or 'milesial')")

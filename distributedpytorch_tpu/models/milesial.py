@@ -1,0 +1,160 @@
+"""The original milesial/Pytorch-UNet architecture, TPU-native.
+
+The reference documents this model as the ancestor of its own UNet
+(reference model/modelsummary.txt:150-247: DoubleConv/Down/Up/OutConv
+blocks, BatchNorm after every conv, 31,037,698 trainable parameters at
+n_classes=2 with transposed-conv upsampling). This is the second model
+family the framework ships; parameter-count golden in tests/test_model.py.
+
+Differences from `models/unet.py`'s reference-course model: twice the
+widths (64→1024 vs 32→512), BatchNorm (bias-free convs), no explicit mid
+block (the deepest Down plays that role), and an optional bilinear
+upsampling mode (halves the deepest width, parameter-free Up).
+
+TPU notes:
+  * NHWC, bfloat16 convs — but BatchNorm runs in float32 (variance in
+    bf16 is numerically unsafe) and casts back.
+  * BatchNorm is STATEFUL: `init` returns a `batch_stats` collection
+    alongside `params`, and the train step must apply with
+    ``mutable=["batch_stats"]`` (train/steps.py `make_train_step` does
+    this automatically — `TrainState.model_state` carries the running
+    stats). Under a GSPMD data-parallel mesh the batch axis is sharded,
+    so the batch statistics XLA computes are GLOBAL-batch statistics:
+    data-parallel training gets SyncBN semantics by construction, unlike
+    torch where `SyncBatchNorm` is a separate opt-in wrapper.
+  * For ``n_classes=1`` (this repo's binary-segmentation task) the output
+    is sigmoid probabilities in float32, matching `models/unet.py`'s
+    contract; for 2+ classes raw logits are returned (milesial trains
+    those with cross-entropy).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributedpytorch_tpu.models.unet import center_crop
+
+MILESIAL_WIDTHS = (64, 128, 256, 512, 1024)
+
+
+class DoubleConv(nn.Module):
+    """[Conv3×3(no bias) → BatchNorm → ReLU] × 2
+    (reference model/modelsummary.txt:155-160)."""
+
+    features: int
+    mid_features: int = 0  # 0 = features (bilinear Up passes in//2)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        mid = self.mid_features or self.features
+        for i, feats in enumerate((mid, self.features)):
+            x = nn.Conv(
+                feats, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                name=f"conv{i + 1}",
+            )(x)
+            # float32 statistics; torch defaults are eps=1e-5, momentum=0.1
+            # (flax momentum = 1 − torch momentum)
+            x = nn.BatchNorm(
+                use_running_average=not train, momentum=0.9, epsilon=1e-5,
+                dtype=jnp.float32, name=f"bn{i + 1}",
+            )(x.astype(jnp.float32))
+            x = nn.relu(x).astype(self.dtype)
+        return x
+
+
+class Down(nn.Module):
+    """MaxPool(2) → DoubleConv (reference modelsummary.txt:161-169)."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        return DoubleConv(self.features, dtype=self.dtype, name="conv")(x, train)
+
+
+class Up(nn.Module):
+    """Upsample → concat skip → DoubleConv (reference modelsummary.txt:193-201).
+
+    ``bilinear=False`` (the documented 31M config): ConvTranspose(k=2,s=2)
+    halving the channels. ``bilinear=True``: parameter-free bilinear resize,
+    DoubleConv with mid = in//2 (milesial's memory-saving mode).
+    """
+
+    features: int
+    bilinear: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, skip: jax.Array, train: bool = False
+    ) -> jax.Array:
+        if self.bilinear:
+            b, h, w, c = x.shape
+            x = jax.image.resize(x, (b, 2 * h, 2 * w, c), method="bilinear")
+            # milesial: DoubleConv(in_channels, out, mid=in_channels // 2)
+            # where in_channels is the CONCATENATED width (skip + upsampled)
+            mid = (x.shape[-1] + skip.shape[-1]) // 2
+        else:
+            x = nn.ConvTranspose(
+                x.shape[-1] // 2, (2, 2), strides=(2, 2), dtype=self.dtype,
+                name="up",
+            )(x)
+            mid = 0
+        skip = center_crop(skip, (x.shape[1], x.shape[2]))
+        x = jnp.concatenate([skip, x], axis=-1)
+        return DoubleConv(
+            self.features, mid_features=mid, dtype=self.dtype, name="conv"
+        )(x, train)
+
+
+class MilesialUNet(nn.Module):
+    """inc → Down×4 → Up×4 → OutConv (reference modelsummary.txt:150-247)."""
+
+    n_classes: int = 1
+    bilinear: bool = False
+    widths: Sequence[int] = MILESIAL_WIDTHS
+    dtype: Any = jnp.bfloat16
+
+    # train/steps.py keys off this to thread the batch_stats collection
+    is_stateful = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        w = tuple(self.widths)
+        assert len(w) >= 2, "milesial needs at least inc + one Down level"
+        factor = 2 if self.bilinear else 1
+        x = DoubleConv(w[0], dtype=self.dtype, name="inc")(x, train)
+        skips = [x]
+        for i, feats in enumerate(w[1:-1]):
+            x = Down(feats, dtype=self.dtype, name=f"down{i + 1}")(x, train)
+            skips.append(x)
+        x = Down(w[-1] // factor, dtype=self.dtype, name=f"down{len(w) - 1}")(
+            x, train
+        )
+        for i, (feats, skip) in enumerate(zip(reversed(w[:-1]), reversed(skips))):
+            x = Up(
+                feats // (factor if i < len(w) - 2 else 1),
+                bilinear=self.bilinear,
+                dtype=self.dtype,
+                name=f"up{i + 1}",
+            )(x, skip, train)
+        x = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype, name="outc")(x)
+        if self.n_classes == 1:
+            return jax.nn.sigmoid(x.astype(jnp.float32))
+        return x.astype(jnp.float32)
+
+
+def init_milesial(
+    model: MilesialUNet, rng: jax.Array, input_hw: Tuple[int, int] = (64, 96)
+):
+    """Initialize; returns ``(params, batch_stats)``."""
+    dummy = jnp.zeros((1, input_hw[0], input_hw[1], 3), jnp.float32)
+    variables = model.init(rng, dummy, train=False)
+    return variables["params"], variables["batch_stats"]

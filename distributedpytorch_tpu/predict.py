@@ -30,15 +30,23 @@ def predict_batches(
     model,
     images: Iterable[np.ndarray],
     batch_size: int = 4,
+    model_state=None,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Stream (probs (b,H,W), inputs (b,H,W,3)) pairs over an iterable of
     (H,W,3) float32 arrays. One jit compile for full batches (plus at most
-    one for a ragged final batch)."""
+    one for a ragged final batch). Stateful models (milesial BatchNorm)
+    pass their running statistics as `model_state` and apply in eval mode."""
     import jax
     import jax.numpy as jnp
 
+    stateful = bool(getattr(model, "is_stateful", False))
+
     @jax.jit
     def forward(p, x):
+        if stateful:
+            return model.apply(
+                {"params": p, "batch_stats": model_state}, x, train=False
+            )
         return model.apply({"params": p}, x)
 
     buf: List[np.ndarray] = []
@@ -58,15 +66,35 @@ def predict_batches(
 
 
 def load_params_for_inference(checkpoint_path: str, model, input_hw: Tuple[int, int]):
-    """Params from a native .ckpt or a reference-format .pth (the format
-    dispatch lives in checkpoint.load_weights, shared with the trainer)."""
+    """(params, model_state) from a native .ckpt or a reference-format .pth
+    (the format dispatch lives in checkpoint.load_weights, shared with the
+    trainer). ``model_state`` is the BatchNorm running stats for stateful
+    models, None otherwise."""
     import jax
+    import jax.numpy as jnp
 
-    from distributedpytorch_tpu.checkpoint import load_weights
-    from distributedpytorch_tpu.models.unet import init_unet_params
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, input_hw[0], input_hw[1], 3))
+    )
+    template = variables["params"]
+    state_template = variables.get("batch_stats")
+    if checkpoint_path.endswith(".pth"):
+        from distributedpytorch_tpu.checkpoint import load_weights
 
-    template = init_unet_params(model, jax.random.key(0), input_hw=input_hw)
-    return load_weights(checkpoint_path, template)
+        return load_weights(checkpoint_path, template), state_template
+    from distributedpytorch_tpu.checkpoint import load_checkpoint
+
+    restored = load_checkpoint(
+        checkpoint_path, template, model_state_target=state_template
+    )
+    model_state = restored["model_state"]
+    if state_template is not None and model_state is None:
+        logger.warning(
+            "checkpoint %s has no batch_stats; using init statistics",
+            checkpoint_path,
+        )
+        model_state = state_template
+    return restored["params"], model_state
 
 
 def run_prediction(
@@ -79,24 +107,30 @@ def run_prediction(
     save_viz: bool = False,
     checkpoint_dir: str = "./checkpoints",
     model_widths: Optional[Sequence[int]] = None,
+    model_arch: str = "unet",
 ) -> List[str]:
     """Predict masks for every image in `input_dir`; returns written paths.
 
-    `model_widths` must match the trained checkpoint's architecture when it
-    was trained with non-default widths (TrainConfig.model_widths).
+    `model_arch`/`model_widths` must match the trained checkpoint's
+    architecture (TrainConfig.model_arch / model_widths).
     """
     from PIL import Image
 
     from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+    from distributedpytorch_tpu.config import TrainConfig
     from distributedpytorch_tpu.data.dataset import BasicDataset
-    from distributedpytorch_tpu.models.unet import ENCODER_WIDTHS, UNet
+    from distributedpytorch_tpu.models import create_model
 
     path = resolve_checkpoint(checkpoint, checkpoint_dir)
 
     w, h = int(image_size[0]), int(image_size[1])
-    widths = tuple(model_widths) if model_widths else ENCODER_WIDTHS
-    model = UNet(widths=widths)
-    params = load_params_for_inference(path, model, input_hw=(h, w))
+    model, _ = create_model(
+        TrainConfig(
+            model_arch=model_arch,
+            model_widths=tuple(model_widths) if model_widths else None,
+        )
+    )
+    params, model_state = load_params_for_inference(path, model, input_hw=(h, w))
 
     files = sorted(
         f
@@ -131,7 +165,9 @@ def run_prediction(
 
     written: List[str] = []
     idx = 0
-    for probs, inputs in predict_batches(params, model, load_stream(), batch_size):
+    for probs, inputs in predict_batches(
+        params, model, load_stream(), batch_size, model_state=model_state
+    ):
         for prob, inp in zip(probs, inputs):
             stem = out_stem(files[idx])
             mask = (prob >= threshold).astype(np.uint8) * 255
@@ -168,6 +204,9 @@ def main():
     parser.add_argument("--model-widths", type=int, nargs="+", default=None,
                         help="Encoder widths if the checkpoint was trained "
                              "with non-default TrainConfig.model_widths")
+    parser.add_argument("--model", dest="model_arch", type=str, default="unet",
+                        choices=["unet", "milesial"],
+                        help="Model family the checkpoint was trained with")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     run_prediction(
@@ -180,6 +219,7 @@ def main():
         save_viz=args.viz,
         checkpoint_dir=args.checkpoint_dir,
         model_widths=args.model_widths,
+        model_arch=args.model_arch,
     )
 
 

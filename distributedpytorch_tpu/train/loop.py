@@ -39,7 +39,6 @@ from distributedpytorch_tpu.checkpoint import load_checkpoint, save_checkpoint
 from distributedpytorch_tpu.config import TrainConfig
 from distributedpytorch_tpu.data import DataLoader, build_dataset, seeded_split
 from distributedpytorch_tpu.evaluate import evaluate
-from distributedpytorch_tpu.models.unet import create_unet, init_unet_params
 from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rate
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
@@ -67,12 +66,23 @@ class Trainer:
         self.rng = rng if rng is not None else jax.random.key(config.seed)
 
         # model + state
-        self.model = create_unet(config)
-        params = init_unet_params(
-            self.model, self.rng, input_hw=(config.image_size[1], config.image_size[0])
+        from distributedpytorch_tpu.models import create_model
+
+        self.model, init_fn = create_model(config)
+        params, model_state = init_fn(
+            self.rng, (config.image_size[1], config.image_size[0])
         )
+        if model_state is not None and config.train_method in ("MP", "DDP_MP"):
+            raise ValueError(
+                f"{config.model_arch!r} carries BatchNorm state, which the "
+                "explicit pipeline schedule does not thread across stages "
+                "yet — use a data-parallel/spatial/FSDP strategy, or "
+                "model_arch='unet'"
+            )
         lr0 = self.strategy.lr_for(config.learning_rate)
-        state, self.tx = create_train_state(params, lr0, config.weight_decay)
+        state, self.tx = create_train_state(
+            params, lr0, config.weight_decay, model_state=model_state
+        )
         self.scheduler = ReduceLROnPlateau(
             lr=lr0, patience=config.plateau_patience, factor=config.plateau_factor
         )
@@ -157,6 +167,16 @@ class Trainer:
         masks = os.path.join(self.config.data_dir, self.config.masks_subdir)
         return build_dataset(images, masks, self.config.image_size)
 
+    def _eval_variables(self):
+        """What the eval step consumes: bare params for pure models, the
+        full variables dict for stateful ones (running BatchNorm stats)."""
+        if self.state.model_state is not None:
+            return {
+                "params": self.state.params,
+                "batch_stats": self.state.model_state,
+            }
+        return self.state.params
+
     def _ckpt_path(self, tag: Optional[str] = None) -> str:
         tag = tag or self.config.method_tag
         return os.path.join(self.config.checkpoint_dir, f"{tag}.ckpt")
@@ -178,10 +198,14 @@ class Trainer:
             )
             logger.info("Loaded reference .pth weights from %s", path)
             return
-        restored = load_checkpoint(path, state.params, state.opt_state)
+        restored = load_checkpoint(
+            path, state.params, state.opt_state, state.model_state
+        )
         new_state = state.replace(params=restored["params"], step=restored["step"])
         if restored["opt_state"] is not None:
             new_state = new_state.replace(opt_state=restored["opt_state"])
+        if restored["model_state"] is not None:
+            new_state = new_state.replace(model_state=restored["model_state"])
         if restored["scheduler"]:
             self.scheduler.load_state_dict(restored["scheduler"])
             new_state = new_state.replace(
@@ -204,6 +228,7 @@ class Trainer:
             step=int(self.state.step),
             epoch=epoch,
             records_state=self.records.state_dict(),
+            model_state=self.state.model_state,
         )
 
     # ------------------------------------------------------------------
@@ -421,7 +446,7 @@ class Trainer:
 
             val_loss, val_dice = evaluate(
                 self.eval_step,
-                self.state.params,
+                self._eval_variables(),
                 self.val_loader,
                 self.strategy.place_batch,
                 progress=self.strategy.is_main,

@@ -34,21 +34,33 @@ from distributedpytorch_tpu.ops.optim import adam_l2
 
 @flax.struct.dataclass
 class TrainState:
-    """Pure-pytree training state (params + Adam state + step counter)."""
+    """Pure-pytree training state (params + Adam state + step counter).
+
+    ``model_state`` carries non-trainable model collections (BatchNorm
+    running statistics for stateful models like models/milesial.py); None
+    for pure-params models — the default keeps every existing caller and
+    checkpoint shape unchanged."""
 
     params: Any
     opt_state: Any
     step: jax.Array
+    model_state: Any = None
 
 
 def create_train_state(
     params,
     learning_rate: float,
     weight_decay: float = 1e-8,
+    model_state=None,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
     tx = adam_l2(learning_rate, weight_decay)
     return (
-        TrainState(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)),
+        TrainState(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+            model_state=model_state,
+        ),
         tx,
     )
 
@@ -62,6 +74,28 @@ def _prep_mask(mask: jax.Array) -> jax.Array:
 def loss_fn(model, params, batch: Dict[str, jax.Array]) -> jax.Array:
     preds = model.apply({"params": params}, batch["image"])
     return bce_dice_loss(preds, _prep_mask(batch["mask"]))
+
+
+def _is_stateful(model) -> bool:
+    """Models that carry non-trainable collections (BatchNorm running
+    stats) declare ``is_stateful = True`` (models/milesial.py)."""
+    return bool(getattr(model, "is_stateful", False))
+
+
+def stateful_loss_fn(
+    model, params, model_state, batch: Dict[str, jax.Array]
+) -> Tuple[jax.Array, Any]:
+    """Training loss for a stateful model: applies with
+    ``mutable=['batch_stats']`` and returns the updated stats as aux.
+    Under a sharded batch the statistics XLA computes are global-batch
+    statistics — SyncBN semantics for free (models/milesial.py notes)."""
+    preds, updates = model.apply(
+        {"params": params, "batch_stats": model_state},
+        batch["image"],
+        train=True,
+        mutable=["batch_stats"],
+    )
+    return bce_dice_loss(preds, _prep_mask(batch["mask"])), updates["batch_stats"]
 
 
 def make_train_step(
@@ -81,19 +115,33 @@ def make_train_step(
     """
 
     grad_scale = float(batch_size) if faithful_loss_scaling else 1.0
-    fwd = jax.checkpoint(loss_fn, static_argnums=(0,)) if remat else loss_fn
+    stateful = _is_stateful(model)
+    raw_fwd = stateful_loss_fn if stateful else loss_fn
+    fwd = jax.checkpoint(raw_fwd, static_argnums=(0,)) if remat else raw_fwd
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):
-        loss, grads = jax.value_and_grad(
-            lambda p: fwd(model, p, batch)
-        )(state.params)
+        # one update body for both model kinds: the pure path carries the
+        # (None) model_state through as aux so the optimizer/step logic
+        # exists exactly once
+        if stateful:
+            value_fn = lambda p: fwd(model, p, state.model_state, batch)  # noqa: E731
+        else:
+            value_fn = lambda p: (fwd(model, p, batch), state.model_state)  # noqa: E731
+        (loss, model_state), grads = jax.value_and_grad(value_fn, has_aux=True)(
+            state.params
+        )
         if grad_scale != 1.0:
             # (batch_size * loss).backward() parity, reference train_utils.py:69
             grads = jax.tree.map(lambda g: g * grad_scale, grads)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            TrainState(
+                params=params,
+                opt_state=opt_state,
+                step=state.step + 1,
+                model_state=model_state,
+            ),
             loss,
         )
 
@@ -133,8 +181,15 @@ def make_eval_step(
     VJP.
     """
 
+    stateful = _is_stateful(model)
+
     def eval_step(params, batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
-        preds = model.apply({"params": params}, batch["image"])
+        if stateful:
+            # `params` is the full variables dict ({'params', 'batch_stats'})
+            # the trainer's _eval_variables() builds; running averages only
+            preds = model.apply(params, batch["image"], train=False)
+        else:
+            preds = model.apply({"params": params}, batch["image"])
         target = _prep_mask(batch["mask"])
         if use_pallas:
             from distributedpytorch_tpu.ops.pallas_kernels import (
