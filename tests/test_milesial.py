@@ -67,7 +67,7 @@ def test_sync_bn_by_construction(tiny, devices):
     def run(method):
         cfg = TrainConfig(
             train_method=method, batch_size=4, compute_dtype="float32",
-            image_size=(8, 8), model_widths=(4, 8),
+            image_size=(8, 8), model_arch="milesial", model_widths=(4, 8),
         )
         strat = build_strategy(cfg)
         # fresh copies: the jitted step donates the whole state, batch_stats
@@ -83,10 +83,13 @@ def test_sync_bn_by_construction(tiny, devices):
         return float(loss), jax.device_get(new_state.model_state)
 
     loss_single, stats_single = run("singleGPU")
-    loss_dp, stats_dp = run("DP")
-    np.testing.assert_allclose(loss_dp, loss_single, rtol=1e-5)
-    for a, b in zip(jax.tree.leaves(stats_single), jax.tree.leaves(stats_dp)):
-        np.testing.assert_allclose(np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6)
+    for method in ("DP", "SP"):
+        loss_m, stats_m = run(method)
+        np.testing.assert_allclose(loss_m, loss_single, rtol=1e-5, err_msg=method)
+        for a, b in zip(jax.tree.leaves(stats_single), jax.tree.leaves(stats_m)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6, err_msg=method
+            )
 
 
 def test_trainer_end_to_end_and_resume(tmp_path):

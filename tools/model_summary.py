@@ -140,6 +140,24 @@ def main():
     mods, total = param_census(params)
     act_rows, act_total = activation_table()
 
+    # second family: the original milesial UNet (reference
+    # modelsummary.txt:150-247 documents it alongside the course model).
+    # eval_shape: only shapes are needed, and a real full-width milesial
+    # init costs ~30 s of CPU XLA compile (channel-dominated, so a small
+    # spatial size does not help the way it does above)
+    from distributedpytorch_tpu.models.milesial import MilesialUNet
+
+    mil = MilesialUNet(n_classes=2, bilinear=False, dtype=jnp.bfloat16)
+    mil_vars = jax.eval_shape(
+        lambda rng: mil.init(rng, jnp.zeros((1, 32, 32, 3))), jax.random.key(0)
+    )
+    mil_total = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(mil_vars["params"])
+    )
+    mil_stats_count = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(mil_vars["batch_stats"])
+    )
+
     lines = []
     lines.append("# MODEL — UNet on TPU (generated by tools/model_summary.py)")
     lines.append("")
@@ -174,6 +192,17 @@ def main():
     lines.append("activations halve that, and XLA frees/reuses buffers the torch")
     lines.append("estimate keeps live. `--remat` (jax.checkpoint) roughly halves the")
     lines.append("backward's activation residency again for ~1/3 more FLOPs.")
+    lines.append("")
+    lines.append("## Second family: milesial UNet (`--model milesial`)")
+    lines.append("")
+    lines.append(f"* Trainable parameters: **{mil_total:,}** at n_classes=2,")
+    lines.append("  transposed-conv upsampling (reference modelsummary.txt:239:")
+    lines.append("  31,037,698)")
+    lines.append(f"* BatchNorm running statistics (non-trainable): {mil_stats_count:,}")
+    lines.append(f"* Parameter memory (float32): {mil_total*4/2**20:.2f} MB")
+    lines.append("  (reference: 118.40 MB, modelsummary.txt:245)")
+    lines.append("* Stateful training: batch_stats ride TrainState.model_state;")
+    lines.append("  SyncBN semantics under data-parallel meshes by construction")
     lines.append("")
 
     if args.measured:
