@@ -169,6 +169,61 @@ def _flatten_params(params) -> Dict[Tuple[str, ...], np.ndarray]:
     return flat
 
 
+def _kernel_to_torch(arr: np.ndarray, transposed: bool) -> np.ndarray:
+    """flax (kh, kw, I, O) → torch conv (O, I, kh, kw) / ConvTranspose
+    (I, O, kh, kw) with a spatial flip — lax.conv_transpose correlates with
+    the mirrored kernel relative to torch's scatter semantics (validated
+    against torch numerics in tests/test_checkpoint.py)."""
+    if transposed:
+        return arr[::-1, ::-1].transpose(2, 3, 0, 1)
+    return arr.transpose(3, 2, 0, 1)
+
+
+def _kernel_from_torch(arr: np.ndarray, transposed: bool) -> np.ndarray:
+    if transposed:
+        return arr.transpose(2, 3, 0, 1)[::-1, ::-1]
+    return arr.transpose(2, 3, 1, 0)
+
+
+def _rebuild_from_named(target, name_map, cleaned, transform):
+    """Rebuild a pytree shaped like ``target`` by looking each flat path up
+    in ``cleaned`` via ``name_map`` and applying ``transform(path, arr)``.
+    Shared by both .pth families (reference course model / milesial)."""
+    flat = {}
+    for path in _flatten_params(target):
+        flat[path] = np.ascontiguousarray(transform(path, cleaned[name_map[path]]))
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            return {k: walk(prefix + (k,), v) for k, v in node.items()}
+        return flat[prefix]
+
+    as_dict = walk((), flax.serialization.to_state_dict(target))
+    return flax.serialization.from_state_dict(target, as_dict)
+
+
+def _save_pth(state_dict: Dict[str, np.ndarray], path: str) -> None:
+    import torch
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    torch.save({k: torch.from_numpy(v.copy()) for k, v in state_dict.items()}, path)
+
+
+def _load_pth(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+
+
+def _strip_module_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """DDP saves ``module.``-prefixed keys (reference quirk 9)."""
+    return {
+        (k[len("module.") :] if k.startswith("module.") else k): np.asarray(v)
+        for k, v in state_dict.items()
+    }
+
+
 def _name_map() -> Dict[Tuple[str, ...], str]:
     """flax param path → reference tensor name."""
     m: Dict[Tuple[str, ...], str] = {}
@@ -184,25 +239,19 @@ def _name_map() -> Dict[Tuple[str, ...], str]:
     return m
 
 
-def export_reference_state_dict(params) -> Dict[str, np.ndarray]:
-    """flax params (NHWC kernels) → reference-named dict (NCHW layouts).
+def _ref_is_transposed(path: Tuple[str, ...]) -> bool:
+    return "upconv" in path[-2]
 
-    Conv kernels (kh, kw, I, O) → torch (O, I, kh, kw); ConvTranspose
-    kernels (kh, kw, I, O) → torch (I, O, kh, kw) with a spatial flip —
-    lax.conv_transpose correlates with the mirrored kernel relative to
-    torch's scatter semantics (validated in tests/test_checkpoint.py).
-    """
-    flat = _flatten_params(params)
+
+def export_reference_state_dict(params) -> Dict[str, np.ndarray]:
+    """flax params (NHWC kernels) → reference-named dict (NCHW layouts,
+    see _kernel_to_torch)."""
     names = _name_map()
     out: Dict[str, np.ndarray] = {}
-    for path, arr in flat.items():
-        name = names[path]
+    for path, arr in _flatten_params(params).items():
         if path[-1] == "kernel":
-            if "upconv" in path[-2]:
-                arr = arr[::-1, ::-1].transpose(2, 3, 0, 1)  # → (I, O, kh, kw)
-            else:
-                arr = arr.transpose(3, 2, 0, 1)  # → (O, I, kh, kw)
-        out[name] = np.ascontiguousarray(arr)
+            arr = _kernel_to_torch(arr, _ref_is_transposed(path))
+        out[names[path]] = np.ascontiguousarray(arr)
     return out
 
 
@@ -211,45 +260,113 @@ def import_reference_state_dict(
 ):
     """Reference-named (possibly ``module.``-prefixed, quirk 9) dict → flax
     params shaped like `params_target`."""
-    cleaned = {
-        (k[len("module.") :] if k.startswith("module.") else k): np.asarray(v)
-        for k, v in state_dict.items()
-    }
-    names = _name_map()
-    target_flat = _flatten_params(params_target)
-    new_flat: Dict[Tuple[str, ...], np.ndarray] = {}
-    for path in target_flat:
-        arr = cleaned[names[path]]
+
+    def transform(path, arr):
         if path[-1] == "kernel":
-            if "upconv" in path[-2]:
-                arr = arr.transpose(2, 3, 0, 1)[::-1, ::-1]  # (I,O,kh,kw) → flax
-            else:
-                arr = arr.transpose(2, 3, 1, 0)  # (O,I,kh,kw) → (kh,kw,I,O)
-        new_flat[path] = np.ascontiguousarray(arr)
+            return _kernel_from_torch(arr, _ref_is_transposed(path))
+        return arr
 
-    def rebuild(prefix, node):
-        if isinstance(node, dict):
-            return {k: rebuild(prefix + (k,), v) for k, v in node.items()}
-        return new_flat[prefix]
-
-    as_dict = rebuild((), flax.serialization.to_state_dict(params_target))
-    return flax.serialization.from_state_dict(params_target, as_dict)
+    return _rebuild_from_named(
+        params_target, _name_map(), _strip_module_prefix(state_dict), transform
+    )
 
 
 def export_reference_pth(params, path: str) -> None:
     """Write a real torch ``.pth`` loadable by the reference's
     ``model.load_state_dict(torch.load(...))`` (reference train.py:43)."""
-    import torch
-
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    sd = {k: torch.from_numpy(v.copy()) for k, v in export_reference_state_dict(params).items()}
-    torch.save(sd, path)
+    _save_pth(export_reference_state_dict(params), path)
 
 
 def import_reference_pth(path: str, params_target):
-    import torch
+    return import_reference_state_dict(_load_pth(path), params_target)
 
-    sd = torch.load(path, map_location="cpu", weights_only=True)
-    return import_reference_state_dict(
-        {k: v.numpy() for k, v in sd.items()}, params_target
+
+# ---------------------------------------------------------------------------
+# milesial/Pytorch-UNet .pth interop (the public upstream family)
+# ---------------------------------------------------------------------------
+#
+# torch module layout (milesial/Pytorch-UNet unet_parts.py): DoubleConv =
+# Sequential(Conv2d, BatchNorm2d, ReLU, Conv2d, BatchNorm2d, ReLU) →
+# tensor stems double_conv.{0,1,3,4}; Down wraps it as maxpool_conv.1;
+# Up holds `up` (ConvTranspose2d) + `conv` (DoubleConv); OutConv holds
+# `conv`. Checkpoints published by that repo load here directly — the
+# strongest migration path for its users.
+
+
+def _milesial_maps(n_levels: int):
+    """(flax params path → torch name, flax batch_stats path → torch name)
+    for a milesial model with ``n_levels`` width entries (stem + n−1 downs).
+    """
+    pmap: Dict[Tuple[str, ...], str] = {}
+    smap: Dict[Tuple[str, ...], str] = {}
+
+    def double_conv(flax_prefix: Tuple[str, ...], torch_stem: str):
+        for conv, bn, c_idx, b_idx in (("conv1", "bn1", 0, 1), ("conv2", "bn2", 3, 4)):
+            pmap[flax_prefix + (conv, "kernel")] = f"{torch_stem}.{c_idx}.weight"
+            pmap[flax_prefix + (bn, "scale")] = f"{torch_stem}.{b_idx}.weight"
+            pmap[flax_prefix + (bn, "bias")] = f"{torch_stem}.{b_idx}.bias"
+            smap[flax_prefix + (bn, "mean")] = f"{torch_stem}.{b_idx}.running_mean"
+            smap[flax_prefix + (bn, "var")] = f"{torch_stem}.{b_idx}.running_var"
+
+    double_conv(("inc",), "inc.double_conv")
+    for i in range(1, n_levels):
+        double_conv((f"down{i}", "conv"), f"down{i}.maxpool_conv.1.double_conv")
+    for i in range(1, n_levels):
+        pmap[(f"up{i}", "up", "kernel")] = f"up{i}.up.weight"
+        pmap[(f"up{i}", "up", "bias")] = f"up{i}.up.bias"
+        double_conv((f"up{i}", "conv"), f"up{i}.conv.double_conv")
+    pmap[("outc", "kernel")] = "outc.conv.weight"
+    pmap[("outc", "bias")] = "outc.conv.bias"
+    return pmap, smap
+
+
+def _milesial_levels(params) -> int:
+    as_dict = flax.serialization.to_state_dict(params)
+    return 1 + sum(1 for k in as_dict if k.startswith("down"))
+
+
+def export_milesial_state_dict(params, batch_stats) -> Dict[str, np.ndarray]:
+    """flax milesial variables → torch-named state dict (NCHW layouts via
+    _kernel_to_torch; ``num_batches_tracked`` zeros included so torch's
+    strict ``load_state_dict`` accepts it)."""
+    pmap, smap = _milesial_maps(_milesial_levels(params))
+    out: Dict[str, np.ndarray] = {}
+    for path, arr in _flatten_params(params).items():
+        if path[-1] == "kernel":
+            arr = _kernel_to_torch(arr, transposed=path[-2] == "up")
+        out[pmap[path]] = np.ascontiguousarray(arr)
+    for path, arr in _flatten_params(batch_stats).items():
+        out[smap[path]] = np.ascontiguousarray(arr)
+        out[smap[path].rsplit(".", 1)[0] + ".num_batches_tracked"] = np.asarray(
+            0, np.int64
+        )
+    return out
+
+
+def import_milesial_state_dict(
+    state_dict: Dict[str, np.ndarray], params_target, stats_target
+):
+    """torch-named milesial dict → (params, batch_stats) shaped like the
+    given targets. Accepts DDP's ``module.`` prefix like the UNet path."""
+    cleaned = _strip_module_prefix(state_dict)
+    pmap, smap = _milesial_maps(_milesial_levels(params_target))
+
+    def p_transform(path, arr):
+        if path[-1] == "kernel":
+            return _kernel_from_torch(arr, transposed=path[-2] == "up")
+        return arr
+
+    return (
+        _rebuild_from_named(params_target, pmap, cleaned, p_transform),
+        _rebuild_from_named(stats_target, smap, cleaned, lambda path, arr: arr),
+    )
+
+
+def export_milesial_pth(params, batch_stats, path: str) -> None:
+    _save_pth(export_milesial_state_dict(params, batch_stats), path)
+
+
+def import_milesial_pth(path: str, params_target, stats_target):
+    return import_milesial_state_dict(
+        _load_pth(path), params_target, stats_target
     )

@@ -173,12 +173,17 @@ def main():
             trainer = Trainer(config)
             result = trainer.train()
         if args.export_pth and runtime.is_main:
-            from distributedpytorch_tpu.checkpoint import export_reference_pth
+            pth = os.path.join(config.checkpoint_dir, f"{config.method_tag}.pth")
+            if config.model_arch == "milesial":
+                from distributedpytorch_tpu.checkpoint import export_milesial_pth
 
-            export_reference_pth(
-                trainer.state.params,
-                os.path.join(config.checkpoint_dir, f"{config.method_tag}.pth"),
-            )
+                export_milesial_pth(
+                    trainer.state.params, trainer.state.model_state, pth
+                )
+            else:
+                from distributedpytorch_tpu.checkpoint import export_reference_pth
+
+                export_reference_pth(trainer.state.params, pth)
         logging.info("Done: %s", result)
     finally:
         shutdown()

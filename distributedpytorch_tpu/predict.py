@@ -79,6 +79,12 @@ def load_params_for_inference(checkpoint_path: str, model, input_hw: Tuple[int, 
     template = variables["params"]
     state_template = variables.get("batch_stats")
     if checkpoint_path.endswith(".pth"):
+        if state_template is not None:
+            # stateful family: milesial/Pytorch-UNet-layout .pth (the
+            # public upstream checkpoints load directly)
+            from distributedpytorch_tpu.checkpoint import import_milesial_pth
+
+            return import_milesial_pth(checkpoint_path, template, state_template)
         from distributedpytorch_tpu.checkpoint import load_weights
 
         return load_weights(checkpoint_path, template), state_template
