@@ -93,6 +93,12 @@ def get_args():
     return parser.parse_args()
 
 
+def resolve_checkpoint_arg(args):
+    """The -c/-l aliasing: -c wins, then -l (which the reference parses but
+    ignores — here it actually loads, reference train.py:19 vs :23)."""
+    return args.checkpoint or (args.load if args.load else None)
+
+
 def _enable_compilation_cache():
     """Persistent XLA compilation cache: first-run UNet compiles cost
     20-40 s on TPU; subsequent launches reload them from disk. Best-effort
@@ -144,7 +150,7 @@ def main():
         model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
         s2d_levels=args.s2d_levels,
-        checkpoint_name=args.checkpoint or (args.load if args.load else None),
+        checkpoint_name=resolve_checkpoint_arg(args),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,
     )
