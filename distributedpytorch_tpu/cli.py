@@ -96,7 +96,7 @@ def get_args():
 def resolve_checkpoint_arg(args):
     """The -c/-l aliasing: -c wins, then -l (which the reference parses but
     ignores — here it actually loads, reference train.py:19 vs :23)."""
-    return args.checkpoint or (args.load if args.load else None)
+    return args.checkpoint or args.load or None
 
 
 def _enable_compilation_cache():

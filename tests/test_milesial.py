@@ -58,7 +58,7 @@ def test_train_step_updates_batch_stats(tiny):
 def test_sync_bn_by_construction(tiny, devices):
     """Under a data-sharded mesh, BatchNorm statistics are computed over
     the GLOBAL batch (XLA inserts the cross-shard mean) — the sharded loss
-    equals the single-device loss, which torch only achieves via the
+    equals the single-device loss (asserted for DP, SP, and FSDP), which torch only achieves via the
     separate SyncBatchNorm wrapper."""
     from distributedpytorch_tpu.parallel import build_strategy
 
@@ -83,7 +83,7 @@ def test_sync_bn_by_construction(tiny, devices):
         return float(loss), jax.device_get(new_state.model_state)
 
     loss_single, stats_single = run("singleGPU")
-    for method in ("DP", "SP"):
+    for method in ("DP", "SP", "FSDP"):
         loss_m, stats_m = run(method)
         np.testing.assert_allclose(loss_m, loss_single, rtol=1e-5, err_msg=method)
         for a, b in zip(jax.tree.leaves(stats_single), jax.tree.leaves(stats_m)):
