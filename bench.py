@@ -204,8 +204,10 @@ def _arm_watchdog(seconds: float) -> None:
     compile calls — no exception ever fires, so without this the artifact
     would be empty when the driver's own timeout kills us. A daemon timer
     cannot be blocked by the GIL-released native call; it prints the JSON
-    line and _exits. Generous default: a healthy run (2 compiles + 2
-    measured windows) finishes in ~4 minutes."""
+    line and _exits. Default 900 s: a healthy run (2 compiles + 2 measured
+    windows) finishes in ~4-6 minutes even with cold compiles over a
+    tunneled runtime, and the watchdog must beat the harness's own kill
+    timeout or the artifact ends up empty anyway."""
     import threading
 
     def fire():
@@ -226,7 +228,7 @@ def _arm_watchdog(seconds: float) -> None:
 
 
 def main():
-    _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", 1500)))
+    _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", 900)))
     try:
         result = run()
     except Exception as exc:
