@@ -244,3 +244,14 @@ class TestMilesialPthInterop:
             t = F.relu(t)
         theirs = t.numpy().transpose(0, 2, 3, 1)
         np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-5)
+
+
+def test_steps_per_dispatch_with_stateful_model(tmp_path):
+    """K=2 fused dispatch vs K=1 for the BatchNorm family: the lax.scan
+    carry includes model_state, so running stats must evolve identically."""
+    from tests.test_trainer import _compare_k_dispatch
+
+    _compare_k_dispatch(
+        tmp_path, "singleGPU", model_arch="milesial", model_widths=(4, 8),
+        image_size=(8, 8), epochs=1,
+    )

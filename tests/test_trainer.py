@@ -121,6 +121,14 @@ def _compare_k_dispatch(tmp_path, method, **kw):
         jax.tree.leaves(jax.device_get(t2.state.params)),
     ):
         np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    # stateful family: running stats must match too — train-mode losses and
+    # grads never read them, so only this catches a miswired scan carry
+    if t2.state.model_state is not None:
+        for s1, s2 in zip(
+            jax.tree.leaves(jax.device_get(t1.state.model_state)),
+            jax.tree.leaves(jax.device_get(t2.state.model_state)),
+        ):
+            np.testing.assert_allclose(s1, s2, rtol=1e-5, atol=1e-6)
 
 
 def test_steps_per_dispatch_equivalence(tmp_path):
