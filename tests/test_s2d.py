@@ -220,3 +220,99 @@ class TestS2DUnderParallelism:
             float(jax.jit(ref_loss)(params)),
             rtol=1e-5, atol=1e-6,
         )
+
+
+class TestPropertyEquivalence:
+    """Property-based exactness: for ANY channel counts, spatial sizes, and
+    segment splits, the s2d kernel builders reproduce the pixel-domain ops.
+    The fixed-shape tests above pin known cases; these sweep the space."""
+
+    @staticmethod
+    def _settings():
+        from hypothesis import HealthCheck, settings
+
+        return settings(
+            max_examples=10,  # each example is an XLA compile on 1 CPU core
+            deadline=None,  # XLA compile times are not flaky-test evidence
+            suppress_health_check=[HealthCheck.too_slow],
+        )
+
+    def test_conv3x3_any_shape(self):
+        from hypothesis import given, strategies as st
+
+        @self._settings()
+        @given(
+            h=st.integers(2, 6).map(lambda k: 2 * k),
+            w=st.integers(2, 6).map(lambda k: 2 * k),
+            cin=st.integers(1, 9),
+            cout=st.integers(1, 9),
+            seed=st.integers(0, 2**31 - 1),
+        )
+        def check(h, w, cin, cout, seed):
+            rng = np.random.default_rng(seed)
+            x = jnp.asarray(rng.standard_normal((1, h, w, cin)), jnp.float32)
+            wk = jnp.asarray(rng.standard_normal((3, 3, cin, cout)), jnp.float32)
+            ref = _pixel_conv(x, wk, jnp.zeros((cout,)))
+            got = s2d.depth_to_space(
+                s2d.conv_same(s2d.space_to_depth(x), s2d.conv3x3_kernel(wk))
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4
+            )
+
+        check()
+
+    def test_conv3x3_any_segments(self):
+        from hypothesis import given, strategies as st
+
+        @self._settings()
+        @given(
+            segs=st.lists(st.integers(1, 5), min_size=1, max_size=4),
+            seed=st.integers(0, 2**31 - 1),
+        )
+        def check(segs, seed):
+            rng = np.random.default_rng(seed)
+            cin = sum(segs)
+            parts = [
+                jnp.asarray(rng.standard_normal((1, 8, 12, c)), jnp.float32)
+                for c in segs
+            ]
+            wk = jnp.asarray(rng.standard_normal((3, 3, cin, 3)), jnp.float32)
+            ref = _pixel_conv(
+                jnp.concatenate(parts, axis=-1), wk, jnp.zeros((3,))
+            )
+            sx = jnp.concatenate(
+                [s2d.space_to_depth(p) for p in parts], axis=-1
+            )
+            got = s2d.depth_to_space(
+                s2d.conv_same(sx, s2d.conv3x3_kernel(wk, in_segments=segs))
+            )
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4
+            )
+
+        check()
+
+    def test_upconv_any_shape(self):
+        from hypothesis import given, strategies as st
+
+        @self._settings()
+        @given(
+            cin=st.integers(1, 8),
+            cout=st.integers(1, 8),
+            seed=st.integers(0, 2**31 - 1),
+        )
+        def check(cin, cout, seed):
+            rng = np.random.default_rng(seed)
+            x = jnp.asarray(rng.standard_normal((1, 5, 7, cin)), jnp.float32)
+            u = jnp.asarray(rng.standard_normal((2, 2, cin, cout)), jnp.float32)
+            m = nn.ConvTranspose(cout, (2, 2), strides=(2, 2))
+            ref = m.apply(
+                {"params": {"kernel": u, "bias": jnp.zeros((cout,))}}, x
+            )
+            got = s2d.depth_to_space(s2d.conv_same(x, s2d.upconv_kernel(u)))
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(ref), atol=1e-4, rtol=1e-4
+            )
+
+        check()
