@@ -229,6 +229,7 @@ class TestPropertyEquivalence:
 
     @staticmethod
     def _settings():
+        pytest.importorskip("hypothesis")  # optional test extra
         from hypothesis import HealthCheck, settings
 
         return settings(
@@ -298,13 +299,15 @@ class TestPropertyEquivalence:
 
         @self._settings()
         @given(
+            h=st.integers(1, 9),
+            w=st.integers(1, 9),
             cin=st.integers(1, 8),
             cout=st.integers(1, 8),
             seed=st.integers(0, 2**31 - 1),
         )
-        def check(cin, cout, seed):
+        def check(h, w, cin, cout, seed):
             rng = np.random.default_rng(seed)
-            x = jnp.asarray(rng.standard_normal((1, 5, 7, cin)), jnp.float32)
+            x = jnp.asarray(rng.standard_normal((1, h, w, cin)), jnp.float32)
             u = jnp.asarray(rng.standard_normal((2, 2, cin, cout)), jnp.float32)
             m = nn.ConvTranspose(cout, (2, 2), strides=(2, 2))
             ref = m.apply(
