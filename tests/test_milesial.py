@@ -18,12 +18,18 @@ REFERENCE_MILESIAL_PARAMS = 31_037_698  # reference model/modelsummary.txt:239
 
 
 def test_param_count_matches_reference_doc():
-    # the documented configuration: n_classes=2, transposed-conv upsampling
+    # the documented configuration: n_classes=2, transposed-conv upsampling.
+    # eval_shape: the count is a pure shape function, and a real full-width
+    # init costs ~10 s of single-core XLA compile (real builds are covered
+    # by the tiny-width trainer tests below)
     m = MilesialUNet(n_classes=2, bilinear=False, dtype=jnp.float32)
-    params, batch_stats = init_milesial(m, jax.random.key(0), input_hw=(32, 48))
-    assert param_count(params) == REFERENCE_MILESIAL_PARAMS
+    variables = jax.eval_shape(
+        lambda rng: m.init(rng, jnp.zeros((1, 32, 48, 3))), jax.random.key(0)
+    )
+    # param_count works on ShapeDtypeStructs too (it only reads .size)
+    assert param_count(variables["params"]) == REFERENCE_MILESIAL_PARAMS
     # running stats are non-trainable: 2 tensors per BatchNorm, 18 BNs
-    assert len(jax.tree.leaves(batch_stats)) == 36
+    assert len(jax.tree.leaves(variables["batch_stats"])) == 36
 
 
 @pytest.fixture(scope="module")

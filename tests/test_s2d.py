@@ -229,16 +229,16 @@ class TestPropertyEquivalence:
 
     @staticmethod
     def _settings():
-        pytest.importorskip("hypothesis")  # optional test extra
         from hypothesis import HealthCheck, settings
 
         return settings(
-            max_examples=10,  # each example is an XLA compile on 1 CPU core
+            max_examples=6,  # each example is an XLA compile on 1 CPU core
             deadline=None,  # XLA compile times are not flaky-test evidence
             suppress_health_check=[HealthCheck.too_slow],
         )
 
     def test_conv3x3_any_shape(self):
+        pytest.importorskip("hypothesis")  # optional test extra
         from hypothesis import given, strategies as st
 
         @self._settings()
@@ -264,6 +264,7 @@ class TestPropertyEquivalence:
         check()
 
     def test_conv3x3_any_segments(self):
+        pytest.importorskip("hypothesis")  # optional test extra
         from hypothesis import given, strategies as st
 
         @self._settings()
@@ -295,6 +296,7 @@ class TestPropertyEquivalence:
         check()
 
     def test_upconv_any_shape(self):
+        pytest.importorskip("hypothesis")  # optional test extra
         from hypothesis import given, strategies as st
 
         @self._settings()
