@@ -54,6 +54,15 @@ def get_args():
                         metavar=("W", "H"), help="Resize target (W H)")
     parser.add_argument("--microbatches", type=int, default=2,
                         help="Pipeline microbatches (MP/DDP_MP); reference hardcodes 2")
+    parser.add_argument("--stages", type=int, default=2,
+                        help="Pipeline stages (MP/DDP_MP); 2 = the "
+                             "reference's encoder|decoder cut; bubble is "
+                             "(S-1)/(M+S-1), so raise --microbatches with S")
+    parser.add_argument("--pipeline-cuts", type=int, nargs="+", default=None,
+                        help="Explicit stage boundaries as model-segment "
+                             "indices (L encoder levels, mid, L decoder "
+                             "levels+head); default: faithful 2-stage cut, "
+                             "even split otherwise")
     parser.add_argument("--num-workers", type=int, default=4,
                         help="Host-side decode threads")
     parser.add_argument("--prefetch-batches", type=int, default=2,
@@ -142,6 +151,8 @@ def main():
         data_dir=args.data_dir,
         image_size=tuple(args.image_size),
         num_microbatches=args.microbatches,
+        num_stages=args.stages,
+        pipeline_cuts=tuple(args.pipeline_cuts) if args.pipeline_cuts else None,
         num_workers=args.num_workers,
         prefetch_batches=args.prefetch_batches,
         steps_per_dispatch=args.steps_per_dispatch,

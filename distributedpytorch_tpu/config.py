@@ -54,7 +54,14 @@ class TrainConfig:
 
     # -- pipeline (MP) ------------------------------------------------------
     num_microbatches: int = 2  # reference hardcodes 2 (unet_model.py:25)
+    # Stages in the GPipe schedule. 2 = the reference's encoder|decoder cut
+    # (unet_model.py:16-20); any S up to the model's 2L+1 segments works —
+    # the bubble is (S−1)/(M+S−1), so raise num_microbatches with S.
     num_stages: int = 2
+    # Where stages begin, as model-segment indices (see UNet.apply_segment:
+    # L encoder levels, mid, L decoder levels+head). None = the faithful
+    # 2-stage cut for S=2, an even split otherwise.
+    pipeline_cuts: Optional[Tuple[int, ...]] = None
 
     # -- precision ----------------------------------------------------------
     # bfloat16 keeps the MXU fed; params and loss stay float32.

@@ -157,7 +157,12 @@ class DataLoader:
             "mask": np.stack([it["mask"] for it in items]),
         }
 
-    def epoch_batches(self, epoch: int = 0) -> Iterator[Batch]:
+    def batch_slices(self, epoch: int = 0) -> list:
+        """This epoch's batches as index slices, in order — THE definition
+        of batch formation, shared by `epoch_batches` and the sharded
+        evaluator (evaluate.evaluate_sharded), which assigns whole slices
+        to processes; one definition keeps their batch formation
+        identical by construction."""
         order = self._epoch_order(epoch)
         cut = (
             len(order) - len(order) % self.batch_size
@@ -165,10 +170,17 @@ class DataLoader:
             else len(order)
         )
         order = order[:cut]
-        slices = [
+        return [
             order[s : s + self.batch_size]
             for s in range(0, len(order), self.batch_size)
         ]
+
+    def load_slice(self, idx_list) -> Batch:
+        """Assemble the batch for one `batch_slices` entry."""
+        return self._load_batch(idx_list)
+
+    def epoch_batches(self, epoch: int = 0) -> Iterator[Batch]:
+        slices = self.batch_slices(epoch)
         if self._pool is None:
             for idx in slices:
                 yield self._load_batch(idx)

@@ -160,33 +160,48 @@ class Encoder(nn.Module):
     skip tensors are emitted in s2d form (the decoder consumes them there
     directly), and the 2×2 maxpool collapses to a max over the s2d group —
     its output is already the next level's pixel-resolution input.
+
+    Levels are individually callable (`level`) so the S-stage pipeline can
+    cut the model anywhere in its linear block order (parallel/pipeline.py);
+    `__call__` chains them and is unchanged in numerics and param naming.
     """
 
     widths: Sequence[int] = ENCODER_WIDTHS
     dtype: Any = jnp.bfloat16
     s2d_levels: int = 0
+    in_features: int = 3  # input channels (RGB images)
 
-    @nn.compact
-    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
-        skips = []
-        in_feats = x.shape[-1]
+    def setup(self):
+        blocks = []
+        in_feats = self.in_features
         for i, w in enumerate(self.widths):
             if i < self.s2d_levels:
-                xs = s2d_ops.space_to_depth(x)
-                xs = ConvBlock(
+                blocks.append(ConvBlock(
                     w,
                     dtype=self.dtype,
                     s2d=True,
                     in_features=in_feats,
                     name=f"block{i + 1}",
-                )(xs)
-                skips.append(xs)  # s2d form
-                x = s2d_ops.group_max(xs)  # = maxpool2x2, at next level's res
+                ))
             else:
-                x = ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}")(x)
-                skips.append(x)
-                x = _maxpool2x2(x)
+                blocks.append(ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}"))
             in_feats = w
+        self.blocks = blocks
+
+    def level(self, x: jax.Array, i: int) -> Tuple[jax.Array, jax.Array]:
+        """Encoder level ``i``: conv block + pool → (pooled, skip)."""
+        if i < self.s2d_levels:
+            xs = s2d_ops.space_to_depth(x)
+            xs = self.blocks[i](xs)
+            return s2d_ops.group_max(xs), xs  # skip stays in s2d form
+        x = self.blocks[i](x)
+        return _maxpool2x2(x), x
+
+    def __call__(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        skips = []
+        for i in range(len(self.widths)):
+            x, skip = self.level(x, i)
+            skips.append(skip)
         return x, tuple(skips)
 
 
@@ -197,44 +212,66 @@ class Decoder(nn.Module):
     widths: Sequence[int] = tuple(reversed(ENCODER_WIDTHS))  # 256,128,64,32
     dtype: Any = jnp.bfloat16
     s2d_levels: int = 0
+    in_features: Optional[int] = None  # bottleneck channels (default 2·widths[0])
 
-    @nn.compact
-    def __call__(self, x: jax.Array, skips: Sequence[jax.Array]) -> jax.Array:
-        # skips arrive encoder-ordered (shallow→deep); consume deepest first.
-        # The shallowest s2d_levels iterations (i ≥ n − s2d_levels) run in the
-        # s2d domain: the upconv becomes a 1×1 conv from the pixel-space
+    def setup(self):
+        # The shallowest s2d_levels iterations (i ≥ n − s2d_levels) run in
+        # the s2d domain: the upconv becomes a 1×1 conv from the pixel-space
         # input, the skip arrives already in s2d form, and the concat needs
         # no data movement (the conv kernel's in_segments absorb the layout).
         n = len(self.widths)
-        x_is_s2d = False
-        for i, (w, skip) in enumerate(zip(self.widths, reversed(skips))):
+        first_in = self.in_features or 2 * self.widths[0]
+        ups, blocks = [], []
+        for i, w in enumerate(self.widths):
+            logical_in = first_in if i == 0 else self.widths[i - 1]
             if i >= n - self.s2d_levels:
-                if x_is_s2d:
-                    x = s2d_ops.depth_to_space(x)
-                up = _S2DConv(
-                    w, x.shape[-1], "upconv", dtype=self.dtype, name=f"upconv{i + 1}"
-                )(x)
-                assert skip.shape == up.shape, (
-                    "s2d decoder expects the identity center-crop (even input "
-                    f"sizes): skip {skip.shape} vs upconv {up.shape}"
-                )
-                x = jnp.concatenate([skip, up], axis=-1)
-                x = ConvBlock(
+                ups.append(_S2DConv(
+                    w, logical_in, "upconv", dtype=self.dtype,
+                    name=f"upconv{i + 1}",
+                ))
+                blocks.append(ConvBlock(
                     w,
                     dtype=self.dtype,
                     s2d=True,
                     in_features=2 * w,
                     in_segments=(w, w),
                     name=f"block{i + 1}",
-                )(x)
-                x_is_s2d = True
+                ))
             else:
-                x = nn.ConvTranspose(
-                    w, (2, 2), strides=(2, 2), dtype=self.dtype, name=f"upconv{i + 1}"
-                )(x)
-                skip = center_crop(skip, (x.shape[1], x.shape[2]))
-                x = jnp.concatenate([skip, x], axis=-1)
-                x = ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}")(x)
+                ups.append(nn.ConvTranspose(
+                    w, (2, 2), strides=(2, 2), dtype=self.dtype,
+                    name=f"upconv{i + 1}",
+                ))
+                blocks.append(ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}"))
+        self.ups = ups
+        self.blocks = blocks
+
+    def level(self, x: jax.Array, skip: jax.Array, i: int) -> jax.Array:
+        """Decoder level ``i``: upconv → crop/concat skip → conv block.
+
+        ``x`` arrives in s2d form iff level ``i−1`` ran in the s2d domain —
+        a static property of ``i``, so pipeline stages can start at any
+        level without threading execution-domain state across stages."""
+        n = len(self.widths)
+        if i >= n - self.s2d_levels:
+            if i - 1 >= n - self.s2d_levels:
+                x = s2d_ops.depth_to_space(x)
+            up = self.ups[i](x)
+            assert skip.shape == up.shape, (
+                "s2d decoder expects the identity center-crop (even input "
+                f"sizes): skip {skip.shape} vs upconv {up.shape}"
+            )
+            x = jnp.concatenate([skip, up], axis=-1)
+            return self.blocks[i](x)
+        x = self.ups[i](x)
+        skip = center_crop(skip, (x.shape[1], x.shape[2]))
+        x = jnp.concatenate([skip, x], axis=-1)
+        return self.blocks[i](x)
+
+    def __call__(self, x: jax.Array, skips: Sequence[jax.Array]) -> jax.Array:
+        # skips arrive encoder-ordered (shallow→deep); consume deepest first.
+        for i in range(len(self.widths)):
+            x = self.level(x, skips[len(skips) - 1 - i], i)
         return x
 
 
@@ -255,6 +292,10 @@ class UNet(nn.Module):
     dtype: Any = jnp.bfloat16
     widths: Sequence[int] = ENCODER_WIDTHS
     mid_width: int = 0  # 0 = 2 × widths[-1] (the reference's 256→512)
+    # Input channels. Static (setup-time) because the s2d execution mode
+    # builds its level-1 kernels from it; the data pipeline always emits
+    # RGB, so non-3 is for library users feeding other imagery.
+    in_channels: int = 3
     # How many shallow levels execute in the space-to-depth domain
     # (ops/s2d.py) — exactly equivalent, measured ~2× faster on TPU for the
     # full-resolution C=32/64 levels. 0 disables; -1 = auto (2 on a TPU
@@ -271,11 +312,17 @@ class UNet(nn.Module):
         mid = self.mid_width or 2 * self.widths[-1]
         lv = self._s2d_levels()
         self.encoder = Encoder(
-            widths=tuple(self.widths), dtype=self.dtype, s2d_levels=lv
+            widths=tuple(self.widths),
+            dtype=self.dtype,
+            s2d_levels=lv,
+            in_features=self.in_channels,
         )
         self.mid = ConvBlock(mid, dtype=self.dtype)
         self.decoder = Decoder(
-            widths=tuple(reversed(self.widths)), dtype=self.dtype, s2d_levels=lv
+            widths=tuple(reversed(self.widths)),
+            dtype=self.dtype,
+            s2d_levels=lv,
+            in_features=mid,
         )
         if lv > 0:
             self.segmap = _S2DConv(
@@ -315,10 +362,45 @@ class UNet(nn.Module):
         bfloat16 resolution near 0/1 would poison it.
         """
         x = self.decoder(x, skips)
+        return self._head(x)
+
+    def _head(self, x: jax.Array) -> jax.Array:
         x = self.segmap(x)
         if self._s2d_levels() > 0:
             x = s2d_ops.depth_to_space(x)  # (B, H/2, W/2, 4·ncls) → (B, H, W, ncls)
         return jax.nn.sigmoid(x.astype(jnp.float32))
+
+    # -- S-stage pipeline segments (parallel/pipeline.py) -------------------
+    # The model's linear block order: L encoder levels, the mid block, then
+    # L decoder levels with the 1×1 head folded into the last. A pipeline
+    # stage is any contiguous run of these 2L+1 segments; the reference's
+    # 2-stage cut (unet_model.py:16-20) is the boundary after segment L.
+    @property
+    def num_segments(self) -> int:
+        return 2 * len(self.widths) + 1
+
+    def apply_segment(
+        self, x: jax.Array, skips: Tuple[jax.Array, ...], seg: int
+    ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Run segment ``seg`` (static int) of the linear block order.
+
+        Carry convention: ``(x, skips)`` where ``skips`` holds the encoder
+        outputs produced so far and not yet consumed — segments push during
+        encode, pop (deepest-first) during decode, so the inter-stage
+        payload at any cut is exactly this carry.
+        """
+        L = len(self.widths)
+        if seg < L:  # encoder level
+            x, skip = self.encoder.level(x, seg)
+            return x, tuple(skips) + (skip,)
+        if seg == L:  # mid block
+            return self.mid(x), tuple(skips)
+        i = seg - L - 1  # decoder level
+        x = self.decoder.level(x, skips[-1], i)
+        skips = tuple(skips)[:-1]
+        if seg == 2 * L:  # last decoder level carries the head
+            x = self._head(x)
+        return x, skips
 
 
 def create_unet(config=None, dtype=None) -> UNet:

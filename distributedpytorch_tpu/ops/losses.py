@@ -24,9 +24,12 @@ import jax.numpy as jnp
 EPS = 1e-15  # reference utils/utils.py:13
 _LOG_CLAMP = -100.0  # torch BCELoss log clamp
 # Below this, x is treated as saturated: the value clamps to -100 and the
-# gradient is 0. Chosen so 1/x stays finite in float32 (subnormals would
-# push 1/x to inf).
-_LOG_SAFE_MIN = 1e-35
+# gradient is 0. The float32 minimum normal — the smallest x where 1/x is
+# still finite (subnormals push 1/x to inf) — so the value-parity gap vs
+# torch's effective clamp point (log(x) = -100 at x ≈ 3.7e-44) is as small
+# as float32 allows: only [3.7e-44, 1.18e-38) clamps early, at log values
+# in (-100, -87.3] (ADVICE r03).
+_LOG_SAFE_MIN = 1.1754944e-38
 
 
 def _clamped_log(x: jax.Array) -> jax.Array:

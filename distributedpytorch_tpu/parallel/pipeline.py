@@ -1,4 +1,4 @@
-"""GPipe-style microbatched pipeline over a 'stage' mesh axis.
+"""GPipe-style microbatched pipeline over a 'stage' mesh axis, S stages.
 
 TPU-native re-design of the reference's hand-written 2-GPU pipeline
 (reference model/unet_model.py:14-53). The reference gets overlap for free
@@ -7,22 +7,32 @@ microbatch i+1, with the bottleneck + all 4 skip tensors copied cuda:0→cuda:1
 each microbatch (unet_model.py:36-37,47-48). On TPU the same schedule is
 written explicitly: `shard_map` over a ``stage`` mesh axis, a static loop
 over schedule ticks, `lax.cond` selecting each device's stage work, and
-`jax.lax.ppermute` carrying the bottleneck + skips stage0→stage1 over ICI.
+`jax.lax.ppermute` carrying inter-stage payloads over ICI.
 
-Schedule shape (parity with §3.3 of SURVEY.md): S=2 stages, M microbatches
-(default 2, reference hardcodes 2 at unet_model.py:25). Ticks t=0..M: stage 0
-encodes microbatch t while stage 1 decodes microbatch t-1 — the classic
-1-warmup/1-drain GPipe bubble.
+Generalized from the round-3 two-stage schedule to S stages (VERDICT r03
+next-3): the model exposes its linear block order as 2L+1 segments
+(models/unet.py `UNet.apply_segment`), a stage is any contiguous run of
+segments, and ``cuts`` picks the boundaries. The default for S=2 is the
+faithful reference cut (encoder+mid | decoder+head, unet_model.py:16-20);
+for S>2 segments are split evenly. Schedule shape: M microbatches over
+M + S − 1 ticks — the standard (S−1)-tick warmup/drain bubble, amortized by
+raising M.
+
+Skip connections cross stages: encoder segments push skip tensors onto the
+carry, decoder segments pop them, so the payload on the edge between stages
+s and s+1 is exactly the carry at that cut — bottleneck + not-yet-consumed
+skips — and intermediate stages relay the skips their segments don't touch.
+Each edge has its own payload shapes; every device materializes every
+edge's (zero) buffer, but only the owning stage's is nonzero, and
+``lax.cond`` keeps the inactive stage computations unexecuted on TPU.
 
 Differentiation: the whole schedule is a pure function of the (replicated)
 params, so `jax.grad` through the `shard_map` gives the pipelined backward
 automatically — `ppermute`'s transpose is the reverse permute, so activation
-cotangents flow stage1→stage0 with the same overlap structure. Parameters are
-replicated across the stage axis (30 MB of params — replication is the right
-trade; what is *pipelined* is the activation traffic, which at
+cotangents flow stage s+1 → s with the same overlap structure. Parameters
+are replicated across the stage axis (30 MB of params — replication is the
+right trade; what is *pipelined* is the activation traffic, which at
 (µB,640,960,32) per skip is the dominant term exactly as in the reference).
-Each device only *executes* its own stage's branch per tick; the inactive
-branch of `lax.cond` is not executed on TPU.
 
 The ('data', 'stage') hybrid falls out for free: batch sharded over 'data',
 schedule over 'stage'; `jax.grad`'s transpose inserts the gradient psum over
@@ -32,7 +42,7 @@ schedule over 'stage'; `jax.grad`'s transpose inserts the gradient psum over
 from __future__ import annotations
 
 import functools
-from typing import Callable
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,16 +52,78 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributedpytorch_tpu.ops.losses import bce_dice_stats, loss_from_stats
 
 
-def _zeros_like_tree(tree):
-    return jax.tree.map(jnp.zeros_like, tree)
+def default_cuts(num_segments: int, num_stages: int) -> Tuple[int, ...]:
+    """Stage boundaries (the segment index each stage s ≥ 1 starts at).
+
+    S=2 reproduces the reference cut — encoder+mid | decoder+head
+    (unet_model.py:16-20) — which for 2L+1 segments is the boundary after
+    segment L. Other S split the segment list as evenly as possible, with
+    the remainder on the LAST stages: the early segments (shallow encoder
+    levels) carry most of the FLOPs, and throughput is set by the slowest
+    stage, so extra segments belong with the cheap deep/decoder work."""
+    if num_stages == 2:
+        return ((num_segments - 1) // 2 + 1,)
+    base, rem = divmod(num_segments, num_stages)
+    sizes = [
+        base + (1 if i >= num_stages - rem else 0) for i in range(num_stages)
+    ]
+    cuts, acc = [], 0
+    for size in sizes[:-1]:
+        acc += size
+        cuts.append(acc)
+    return tuple(cuts)
 
 
-def _send_to_next_stage(tree, axis_name: str, num_stages: int):
-    """ppermute every leaf stage s → s+1 (last stage's output is dropped)."""
-    perm = [(s, s + 1) for s in range(num_stages - 1)]
+def _stage_ranges(
+    num_segments: int, num_stages: int, cuts: Optional[Sequence[int]]
+) -> list:
+    if num_stages < 1 or num_stages > num_segments:
+        raise ValueError(
+            f"num_stages {num_stages} out of range for a "
+            f"{num_segments}-segment model"
+        )
+    cuts = tuple(cuts) if cuts is not None else default_cuts(num_segments, num_stages)
+    if len(cuts) != num_stages - 1 or list(cuts) != sorted(set(cuts)) or any(
+        not 0 < c < num_segments for c in cuts
+    ):
+        raise ValueError(
+            f"cuts {cuts} must be {num_stages - 1} strictly increasing "
+            f"segment indices in (0, {num_segments})"
+        )
+    bounds = (0,) + cuts + (num_segments,)
+    return [range(bounds[s], bounds[s + 1]) for s in range(num_stages)]
+
+
+def _ppermute_edge(tree, axis_name: str, edge: int):
+    """Move edge ``edge``'s payload from stage `edge` to stage `edge`+1
+    (every other device receives zeros — which is what inactive stages
+    should hold)."""
     return jax.tree.map(
-        lambda x: jax.lax.ppermute(x, axis_name, perm=perm), tree
+        lambda x: jax.lax.ppermute(x, axis_name, perm=[(edge, edge + 1)]), tree
     )
+
+
+def _zeros_of(template):
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+
+
+def _build_stage_fns(model, stage_ranges, remat: bool):
+    """One function per stage: chain its segments' (x, skips) → (x, skips)."""
+
+    def seg_apply(params, x, skips, seg):
+        return model.apply(
+            {"params": params}, x, skips, seg, method=type(model).apply_segment
+        )
+
+    fns = []
+    for rng in stage_ranges:
+        def stage_fn(params, x, skips, _rng=rng):
+            for seg in _rng:
+                x, skips = seg_apply(params, x, skips, seg)
+            return x, skips
+
+        fns.append(jax.checkpoint(stage_fn) if remat else stage_fn)
+    return fns
 
 
 def make_pipeline_loss_fn(
@@ -61,9 +133,10 @@ def make_pipeline_loss_fn(
     stage_axis: str = "stage",
     data_axis: str = None,
     remat: bool = False,
+    cuts: Optional[Sequence[int]] = None,
 ) -> Callable:
-    """Build ``loss_fn(params, batch) -> loss`` running the 2-stage GPipe
-    schedule over `mesh`'s ``stage`` axis.
+    """Build ``loss_fn(params, batch) -> loss`` running the S-stage GPipe
+    schedule over `mesh`'s ``stage`` axis (S = the axis size).
 
     `batch` is ``{'image': (B,H,W,3) f32, 'mask': (B,H,W,1) f32 target}``
     with B divisible by num_microbatches (× data-axis size when hybrid).
@@ -71,17 +144,10 @@ def make_pipeline_loss_fn(
     full batch (microbatches are equal-sized, so mean-of-µmeans == mean).
     """
     num_stages = mesh.shape[stage_axis]
-    if num_stages != 2:
-        raise ValueError(
-            f"2-stage pipeline (reference cut, unet_model.py:16-20); got {num_stages}"
-        )
+    stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
+    stage_fns = _build_stage_fns(model, stage_ranges, remat)
     M = int(num_microbatches)
-
-    encode = model.encode_mid
-    decode = model.decode_head
-    if remat:
-        encode = jax.checkpoint(encode)
-        decode = jax.checkpoint(decode)
+    S = num_stages
 
     batch_spec = P(data_axis) if data_axis else P()
     in_specs = (P(), {"image": batch_spec, "mask": batch_spec})
@@ -98,56 +164,65 @@ def make_pipeline_loss_fn(
             )
         mb = images.shape[0] // M  # microbatch size (static)
 
-        def encode_mb(t):
-            x = jax.lax.dynamic_slice_in_dim(images, t * mb, mb, axis=0)
-            bottleneck, skips = model.apply(
-                {"params": params}, x, method=encode
-            )
-            return bottleneck, skips
+        def microbatch_input(m):
+            return jax.lax.dynamic_slice_in_dim(images, m * mb, mb, axis=0), ()
 
-        # Shape/dtype template for the inter-stage payload.
-        template = jax.eval_shape(lambda: encode_mb(0))
-        zero_payload = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), template
-        )
+        # Per-edge payload templates: chain the stage functions over one
+        # microbatch's shapes (eval_shape — no FLOPs, no memory).
+        def simulate(params):
+            x = jnp.zeros((mb,) + images.shape[1:], images.dtype)
+            skips = ()
+            outs = []
+            for s in range(S - 1):
+                x, skips = stage_fns[s](params, x, skips)
+                outs.append((x, skips))
+            return tuple(outs)
 
-        def decode_mb(payload, t):
-            bottleneck, skips = payload
-            preds = model.apply(
-                {"params": params}, bottleneck, skips, method=decode
-            )
-            target = jax.lax.dynamic_slice_in_dim(masks, t * mb, mb, axis=0)
+        templates = jax.eval_shape(simulate, params)
+        zero_payloads = [_zeros_of(t) for t in templates]
+
+        def last_stage_stats(params, payload, m):
+            x, skips = stage_fns[S - 1](params, *payload)
+            target = jax.lax.dynamic_slice_in_dim(masks, m * mb, mb, axis=0)
             # The log-dice term is a ratio of WHOLE-batch sums (reference
             # utils.py:18-23 computes it on the concatenated pipe output), so
             # microbatches accumulate sufficient statistics, not losses.
-            return bce_dice_stats(preds, target)
+            return bce_dice_stats(x, target)
 
         stats_sum = jnp.zeros((4,), jnp.float32)
-        in_flight = zero_payload
-        for t in range(M + 1):
-            # Stage 0 encodes microbatch t (ticks 0..M-1); other stages and
-            # drained ticks produce zeros that ppermute discards downstream.
-            produce = jnp.logical_and(stage == 0, t < M)
-            payload = jax.lax.cond(
-                produce,
-                lambda: encode_mb(min(t, M - 1)),
-                lambda: zero_payload,
-            )
-            # Stage 1 decodes microbatch t-1 (ticks 1..M) from what arrived
-            # last tick.
-            consume = jnp.logical_and(stage == num_stages - 1, t >= 1)
-            stats_t = jax.lax.cond(
-                consume,
-                functools.partial(decode_mb, in_flight),
-                lambda _unused: jnp.zeros((4,), jnp.float32),
-                max(t - 1, 0),
-            )
-            stats_sum = stats_sum + stats_t
-            # Move this tick's product to the next stage for tick t+1.
-            in_flight = _send_to_next_stage(payload, stage_axis, num_stages)
+        in_flight = list(zero_payloads)  # in_flight[e] feeds stage e+1
+        for t in range(M + S - 1):
+            outgoing = [None] * (S - 1)
+            for s in range(S):
+                m = t - s  # microbatch stage s handles this tick (static)
+                if not 0 <= m < M:
+                    continue
+                payload_in = (
+                    microbatch_input(m) if s == 0 else in_flight[s - 1]
+                )
+                if s < S - 1:
+                    outgoing[s] = jax.lax.cond(
+                        stage == s,
+                        functools.partial(stage_fns[s], params, *payload_in),
+                        lambda _s=s: zero_payloads[_s],
+                    )
+                else:
+                    stats_sum = stats_sum + jax.lax.cond(
+                        stage == s,
+                        functools.partial(
+                            last_stage_stats, params, payload_in, m
+                        ),
+                        lambda: jnp.zeros((4,), jnp.float32),
+                    )
+            in_flight = [
+                _ppermute_edge(outgoing[e], stage_axis, e)
+                if outgoing[e] is not None
+                else zero_payloads[e]
+                for e in range(S - 1)
+            ]
 
-        # Sum stats across the stage axis (stage 0 contributed zeros) and,
-        # in the hybrid, across data shards — the result is the EXACT
+        # Sum stats across the stage axis (only the last stage contributed)
+        # and, in the hybrid, across data shards — the result is the EXACT
         # full-global-batch loss, not an average of shard losses.
         axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
         stats = jax.lax.psum(stats_sum, axes)
@@ -168,55 +243,78 @@ def make_pipeline_forward_fn(
     num_microbatches: int = 2,
     stage_axis: str = "stage",
     data_axis: str = None,
+    cuts: Optional[Sequence[int]] = None,
 ) -> Callable:
     """Pipelined inference: ``forward(params, images) -> preds``.
 
-    Same schedule as the loss path; predictions are ppermuted back to every
-    stage so the output is replicated across 'stage' (the reference's
+    Same schedule as the loss path; predictions are psummed across the
+    stage axis so the output is replicated over 'stage' (the reference's
     ``.to('cuda:0')`` gather, unet_model.py:53).
     """
     num_stages = mesh.shape[stage_axis]
+    stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
+    stage_fns = _build_stage_fns(model, stage_ranges, remat=False)
     M = int(num_microbatches)
+    S = num_stages
     batch_spec = P(data_axis) if data_axis else P()
 
     def per_device(params, images):
         stage = jax.lax.axis_index(stage_axis)
         mb = images.shape[0] // M
 
-        def encode_mb(t):
-            x = jax.lax.dynamic_slice_in_dim(images, t * mb, mb, axis=0)
-            return model.apply({"params": params}, x, method=model.encode_mid)
+        def microbatch_input(m):
+            return jax.lax.dynamic_slice_in_dim(images, m * mb, mb, axis=0), ()
 
-        template = jax.eval_shape(lambda: encode_mb(0))
-        zero_payload = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
+        def simulate(params):
+            x = jnp.zeros((mb,) + images.shape[1:], images.dtype)
+            skips = ()
+            outs = []
+            for s in range(S - 1):
+                x, skips = stage_fns[s](params, x, skips)
+                outs.append((x, skips))
+            return tuple(outs)
 
-        def decode_mb(payload):
-            bottleneck, skips = payload
-            return model.apply(
-                {"params": params}, bottleneck, skips, method=model.decode_head
-            )
-
+        templates = jax.eval_shape(simulate, params)
+        zero_payloads = [_zeros_of(t) for t in templates]
         out_shape = (mb,) + images.shape[1:3] + (model.n_classes,)
+
+        def last_stage_preds(params, payload):
+            x, _skips = stage_fns[S - 1](params, *payload)
+            return x
+
         preds = []
-        in_flight = zero_payload
-        for t in range(M + 1):
-            produce = jnp.logical_and(stage == 0, t < M)
-            payload = jax.lax.cond(
-                produce, lambda: encode_mb(min(t, M - 1)), lambda: zero_payload
-            )
-            consume = jnp.logical_and(stage == num_stages - 1, t >= 1)
-            pred_t = jax.lax.cond(
-                consume,
-                functools.partial(decode_mb, in_flight),
-                lambda: jnp.zeros(out_shape, jnp.float32),
-            )
-            if t >= 1:
-                preds.append(pred_t)
-            in_flight = _send_to_next_stage(payload, stage_axis, num_stages)
+        in_flight = list(zero_payloads)
+        for t in range(M + S - 1):
+            outgoing = [None] * (S - 1)
+            for s in range(S):
+                m = t - s
+                if not 0 <= m < M:
+                    continue
+                payload_in = (
+                    microbatch_input(m) if s == 0 else in_flight[s - 1]
+                )
+                if s < S - 1:
+                    outgoing[s] = jax.lax.cond(
+                        stage == s,
+                        functools.partial(stage_fns[s], params, *payload_in),
+                        lambda _s=s: zero_payloads[_s],
+                    )
+                else:
+                    preds.append(jax.lax.cond(
+                        stage == s,
+                        functools.partial(last_stage_preds, params, payload_in),
+                        lambda: jnp.zeros(out_shape, jnp.float32),
+                    ))
+            in_flight = [
+                _ppermute_edge(outgoing[e], stage_axis, e)
+                if outgoing[e] is not None
+                else zero_payloads[e]
+                for e in range(S - 1)
+            ]
 
         out = jnp.concatenate(preds, axis=0)
-        # Replicate across the stage axis: stage 1 holds the real output,
-        # stage 0 holds zeros → psum is a broadcast-from-last-stage.
+        # Replicate across the stage axis: the last stage holds the real
+        # output, the rest hold zeros → psum is a broadcast-from-last-stage.
         return jax.lax.psum(out, stage_axis)
 
     return shard_map(

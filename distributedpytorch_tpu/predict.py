@@ -114,11 +114,17 @@ def run_prediction(
     checkpoint_dir: str = "./checkpoints",
     model_widths: Optional[Sequence[int]] = None,
     model_arch: str = "unet",
+    s2d_levels: int = -1,
 ) -> List[str]:
     """Predict masks for every image in `input_dir`; returns written paths.
 
     `model_arch`/`model_widths` must match the trained checkpoint's
-    architecture (TrainConfig.model_arch / model_widths).
+    architecture (TrainConfig.model_arch / model_widths). ``s2d_levels``
+    follows TrainConfig (-1 = auto); sizes the space-to-depth mode cannot
+    express (H or W not divisible by 2**levels) auto-fall-back to the
+    pixel path — checkpoints are identical across execution modes, so
+    this changes speed, never results (ADVICE r03: there was previously
+    no inference-side workaround at all).
     """
     from PIL import Image
 
@@ -130,12 +136,21 @@ def run_prediction(
     path = resolve_checkpoint(checkpoint, checkpoint_dir)
 
     w, h = int(image_size[0]), int(image_size[1])
-    model, _ = create_model(
-        TrainConfig(
-            model_arch=model_arch,
-            model_widths=tuple(model_widths) if model_widths else None,
-        )
+    cfg = TrainConfig(
+        model_arch=model_arch,
+        model_widths=tuple(model_widths) if model_widths else None,
+        s2d_levels=s2d_levels,
     )
+    div = 2 ** cfg.model_levels
+    if s2d_levels != 0 and (h % div or w % div):
+        import dataclasses
+
+        logger.info(
+            "image size %dx%d not divisible by %d: space-to-depth execution "
+            "unavailable, using the (equivalent) pixel path", w, h, div,
+        )
+        cfg = dataclasses.replace(cfg, s2d_levels=0)
+    model, _ = create_model(cfg)
     params, model_state = load_params_for_inference(path, model, input_hw=(h, w))
 
     files = sorted(
@@ -213,6 +228,10 @@ def main():
     parser.add_argument("--model", dest="model_arch", type=str, default="unet",
                         choices=["unet", "milesial"],
                         help="Model family the checkpoint was trained with")
+    parser.add_argument("--s2d-levels", type=int, default=-1,
+                        help="Space-to-depth execution levels (-1 auto, "
+                             "0 pixel path); non-divisible image sizes "
+                             "fall back to 0 automatically")
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO, format="%(message)s")
     run_prediction(
@@ -226,6 +245,7 @@ def main():
         checkpoint_dir=args.checkpoint_dir,
         model_widths=args.model_widths,
         model_arch=args.model_arch,
+        s2d_levels=args.s2d_levels,
     )
 
 

@@ -38,7 +38,7 @@ import numpy as np
 from distributedpytorch_tpu.checkpoint import load_checkpoint, save_checkpoint
 from distributedpytorch_tpu.config import TrainConfig
 from distributedpytorch_tpu.data import DataLoader, build_dataset, seeded_split
-from distributedpytorch_tpu.evaluate import evaluate
+from distributedpytorch_tpu.evaluate import evaluate, evaluate_sharded
 from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rate
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
@@ -120,12 +120,14 @@ class Trainer:
             shard=self.strategy.data_shard(),
             num_workers=config.num_workers,
         )
-        # Val: unsharded, drop_last=True (reference train_utils.py:42).
-        # Deliberate divergence from the reference's rank-0-only eval
-        # (reference :235-241): EVERY process evaluates the same unsharded
-        # val set, so the plateau scheduler sees identical val losses
-        # everywhere and per-rank lr divergence (reference quirk 7) cannot
-        # happen. Redundant work, bought for determinism.
+        # Val: drop_last=True (reference train_utils.py:42). The loader is
+        # unsharded — batch formation is identical everywhere — but
+        # multi-process strategies ASSIGN whole batches round-robin
+        # (evaluate_sharded): each process computes 1/world of the val set
+        # and every process reads back identical per-batch metrics from
+        # the grouped dispatch, so the plateau scheduler stays in lockstep
+        # (the reference's rank-divergent lr, quirk 7, cannot happen) with
+        # no redundant work.
         self.val_loader = DataLoader(
             self.dataset,
             indices=val_idx,
@@ -145,6 +147,12 @@ class Trainer:
             else None
         )
         self.eval_step = self.strategy.build_eval_step(self.model)
+        # grouped variant only where there are processes to share with
+        self.grouped_eval_step = (
+            self.strategy.build_grouped_eval_step(self.model)
+            if self.strategy.eval_shard().world > 1
+            else None
+        )
         self.records = LossRecords(
             config.method_tag, config.loss_dir, every=config.metric_every_steps
         )
@@ -444,13 +452,24 @@ class Trainer:
                 )
                 break
 
-            val_loss, val_dice = evaluate(
-                self.eval_step,
-                self._eval_variables(),
-                self.val_loader,
-                self.strategy.place_batch,
-                progress=self.strategy.is_main,
-            )
+            if self.grouped_eval_step is not None:
+                val_loss, val_dice = evaluate_sharded(
+                    self.eval_step,
+                    self.grouped_eval_step,
+                    self._eval_variables(),
+                    self.val_loader,
+                    self.strategy.place_batch,
+                    self.strategy.eval_shard(),
+                    progress=self.strategy.is_main,
+                )
+            else:
+                val_loss, val_dice = evaluate(
+                    self.eval_step,
+                    self._eval_variables(),
+                    self.val_loader,
+                    self.strategy.place_batch,
+                    progress=self.strategy.is_main,
+                )
             self.records.record_val(global_step, val_loss, val_dice)
             new_lr = self.scheduler.step(val_loss)
             # float32 state vs python float: compare with tolerance

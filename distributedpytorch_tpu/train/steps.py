@@ -168,8 +168,31 @@ def make_multi_train_step(
     return multi_step
 
 
+def grouped_eval_metrics(
+    preds: jax.Array, target: jax.Array, groups: int
+) -> Dict[str, jax.Array]:
+    """Per-group {loss (G,), dice (G,)} of a (G·b, ...) prediction stack.
+
+    Group g's metrics are EXACTLY what `bce_dice_loss`/`dice_coefficient`
+    return on that b-sized batch alone — same reduction shapes, same
+    order — so G reference-semantics val batches evaluate in ONE dispatch.
+    Under a batch sharded over a 'data' mesh axis the leading reshape is a
+    split along the sharded axis: each shard computes its own group's
+    metrics with no cross-device traffic until the tiny (G,) outputs.
+    This is how multi-process eval divides the val set (VERDICT r03
+    next-4): process p feeds its own batch as shard p and every process
+    reads back the same per-batch values.
+    """
+    p = preds.reshape((groups, -1) + preds.shape[1:])
+    t = target.reshape((groups, -1) + target.shape[1:])
+    losses, dices = jax.vmap(
+        lambda pp, tt: (bce_dice_loss(pp, tt), dice_coefficient(pp, tt))
+    )(p, t)
+    return {"loss": losses, "dice": dices}
+
+
 def make_eval_step(
-    model, use_pallas: bool = False
+    model, use_pallas: bool = False, groups: int = 1
 ) -> Callable[[Any, Dict[str, jax.Array]], Dict[str, jax.Array]]:
     """Eval step: per-batch mean loss (reference evaluate.py:16-19) plus the
     hard-Dice metric the reference never computes (SURVEY.md §2 quirk 6).
@@ -179,6 +202,11 @@ def make_eval_step(
     the XLA path within summation-order tolerance (~1e-5 relative).
     Eval-only: the train loss stays XLA so autodiff needs no hand-written
     VJP.
+
+    `groups > 1` evaluates a (G·b)-sized stack of G independent val
+    batches at once and returns vector metrics (see
+    `grouped_eval_metrics`); the Pallas kernel is scalar-only and is
+    ignored in that mode.
     """
 
     stateful = _is_stateful(model)
@@ -191,6 +219,8 @@ def make_eval_step(
         else:
             preds = model.apply({"params": params}, batch["image"])
         target = _prep_mask(batch["mask"])
+        if groups > 1:
+            return grouped_eval_metrics(preds, target, groups)
         if use_pallas:
             from distributedpytorch_tpu.ops.pallas_kernels import (
                 eval_metrics_pallas,

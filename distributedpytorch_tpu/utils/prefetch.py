@@ -36,34 +36,32 @@ def bounded_prefetch(
     """Yield ``(item, fn(item))`` with ``fn`` running up to ``depth`` items
     ahead on a daemon thread.
 
-    The bound counts results the worker holds: queued completions plus the
-    one a blocked ``put`` is holding total ``depth``, so at steady state
-    ``depth`` results (+ the one the consumer is using) are alive at once —
-    for device placement, that many batches of device memory."""
-    q: queue_mod.Queue = queue_mod.Queue(maxsize=max(1, depth - 1))
+    The bound counts results the worker holds: a semaphore permit is taken
+    BEFORE ``fn`` runs and returned when the consumer pops the result, so
+    at most ``depth`` worker-held results (+ the one the consumer is using)
+    are alive at once — for device placement, that many batches of device
+    memory, including at ``depth=1`` (the round-3 queue-based bound kept
+    one extra: a blocked put held a result the accounting missed,
+    ADVICE r03)."""
+    in_flight = threading.Semaphore(max(1, depth))
+    q: queue_mod.Queue = queue_mod.Queue()  # unbounded; the semaphore bounds
     stop = threading.Event()
-
-    def put(payload) -> bool:
-        """Blocking put that gives up when the consumer is gone."""
-        while not stop.is_set():
-            try:
-                q.put(payload, timeout=0.1)
-                return True
-            except queue_mod.Full:
-                continue
-        return False
 
     def worker():
         try:
             for item in items:
+                # poll-acquire so a walked-away consumer (stop set) never
+                # leaves the worker blocked forever on a permit
+                while not in_flight.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
                 if stop.is_set():
                     return
-                if not put((item, fn(item))):
-                    return
+                q.put((item, fn(item)))
         except BaseException as exc:  # re-raised at the consumption point
-            put(exc)
+            q.put(exc)
             return
-        put(_DONE)
+        q.put(_DONE)
 
     threading.Thread(target=worker, daemon=True, name="dpt-prefetch").start()
     try:
@@ -73,6 +71,7 @@ def bounded_prefetch(
                 return
             if isinstance(payload, BaseException):
                 raise payload
+            in_flight.release()  # the consumer owns this result now
             yield payload
     finally:
         stop.set()

@@ -170,6 +170,71 @@ class TestPipelineNumerics:
         np.testing.assert_allclose(float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6)
         _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
 
+    def test_four_stage_loss_and_grads(self, pbatch):
+        """S=4 over a 2-level model (5 segments: enc1, enc2, mid, dec1,
+        dec2+head): loss AND grads match the plain step — the generalized
+        schedule's warmup/drain masking, per-edge ppermutes, and their
+        transposes are all load-bearing here (VERDICT r03 next-3)."""
+        from distributedpytorch_tpu.parallel.pipeline import default_cuts
+
+        model = UNet(dtype=jnp.float32, widths=(8, 16))
+        assert model.num_segments == 5
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, self.PH, self.PW, 3))
+        )["params"]
+        cfg = TrainConfig(
+            train_method="MP", batch_size=B, compute_dtype="float32",
+            image_size=(self.PW, self.PH), model_widths=(8, 16),
+            num_stages=4, num_microbatches=4,
+        )
+        strat = build_strategy(cfg)
+        assert dict(strat.mesh.shape) == {"stage": 4}
+        # remainder lands on the LAST stage (stage 0's shallow encoder
+        # level is the FLOP-heaviest segment; the slowest stage sets
+        # throughput)
+        assert default_cuts(5, 4) == (1, 2, 3)
+        loss_fn = make_pipeline_loss_fn(
+            model, strat.mesh, num_microbatches=4
+        )
+        ref_loss, ref_grads = _ref_loss_and_grads(model, params, pbatch)
+        prepped = _prep(pbatch)
+        pipe_loss, pipe_grads = jax.jit(
+            jax.value_and_grad(lambda p: loss_fn(p, prepped))
+        )(params)
+        np.testing.assert_allclose(
+            float(pipe_loss), float(ref_loss), rtol=1e-5, atol=1e-6
+        )
+        _tree_allclose(ref_grads, pipe_grads, rtol=2e-4, atol=1e-5)
+
+    def test_three_stage_forward_and_custom_cuts(self, pmodel, pparams, pbatch):
+        """S=3 on the 1-level model (3 segments, one per stage) with
+        explicit cuts; the pipelined forward must equal the plain apply."""
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:3]), ("stage",))
+        fwd = make_pipeline_forward_fn(
+            pmodel, mesh, num_microbatches=2, cuts=(1, 2)
+        )
+        ref = pmodel.apply({"params": pparams}, jnp.asarray(pbatch["image"]))
+        out = jax.jit(fwd)(pparams, jnp.asarray(pbatch["image"]))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6
+        )
+
+    def test_bad_cuts_raise(self, pmodel):
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("stage",))
+        with pytest.raises(ValueError, match="cuts"):
+            make_pipeline_loss_fn(pmodel, mesh, cuts=(0,))
+        with pytest.raises(ValueError, match="cuts"):
+            make_pipeline_loss_fn(pmodel, mesh, cuts=(1, 2))
+        with pytest.raises(ValueError, match="num_stages"):
+            make_pipeline_loss_fn(
+                pmodel, Mesh(np.array(jax.devices()[:4]), ("stage",)), cuts=None
+            )
+
+
 
 class TestStrategySteps:
     """Full train-step equivalence: one Adam step under each strategy lands
@@ -298,6 +363,97 @@ class TestStrategySteps:
         np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-6, atol=1e-7)
         _tree_allclose(ref_params, got_params, rtol=1e-5, atol=1e-6)
 
+    def test_dp_mesh_shrink_warns(self, caplog):
+        """An indivisible batch shrinks the data mesh — loudly (VERDICT r03
+        missing-3: the silent shrink left devices idle with no trace)."""
+        import logging
+
+        cfg = TrainConfig(
+            train_method="DP", batch_size=3, compute_dtype="float32",
+            image_size=(W, H), model_widths=WIDTHS,
+        )
+        with caplog.at_level(logging.WARNING):
+            strat = build_strategy(cfg)
+        assert dict(strat.mesh.shape) == {"data": 3}
+        assert any("mesh shrunk" in r.message for r in caplog.records)
+
     def test_unknown_method_raises(self):
         with pytest.raises(ValueError, match="Unknown train method"):
             build_strategy(_config("FSDP9000"))
+
+
+class TestGroupedEval:
+    """Sharded evaluation (VERDICT r03 next-4): per-group metrics from one
+    grouped dispatch must equal per-batch evaluation exactly — that is the
+    property that lets multi-process runs split the val set while every
+    process still sees identical values."""
+
+    G = 4  # groups per dispatch (the multi-process world size)
+
+    def test_grouped_metrics_exact(self, model, params, batch):
+        from distributedpytorch_tpu.ops.losses import (
+            bce_dice_loss,
+            dice_coefficient,
+        )
+        from distributedpytorch_tpu.train.steps import make_eval_step
+
+        per_batch = jax.jit(make_eval_step(model))
+        grouped = jax.jit(make_eval_step(model, groups=self.G))
+
+        rng = np.random.default_rng(1)
+        stacked = {
+            "image": rng.random((self.G * B, H, W, 3), dtype=np.float32),
+            "mask": (rng.random((self.G * B, H, W)) > 0.5).astype(np.int32),
+        }
+        got = jax.device_get(grouped(params, stacked))
+        assert got["loss"].shape == (self.G,)
+        for g in range(self.G):
+            one = {
+                k: v[g * B : (g + 1) * B] for k, v in stacked.items()
+            }
+            want = jax.device_get(per_batch(params, one))
+            np.testing.assert_array_equal(got["loss"][g], want["loss"])
+            np.testing.assert_array_equal(got["dice"][g], want["dice"])
+
+    def test_grouped_metrics_data_sharded(self, model, params, batch):
+        """The multi-process compute path: the grouped stack sharded over a
+        'data' mesh axis (one group per shard) gives the same values as the
+        unsharded dispatch."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from distributedpytorch_tpu.train.steps import make_eval_step
+
+        G = 8
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+        rng = np.random.default_rng(2)
+        stacked = {
+            "image": rng.random((G * 4, H, W, 3), dtype=np.float32),
+            "mask": (rng.random((G * 4, H, W)) > 0.5).astype(np.int32),
+        }
+        grouped = jax.jit(make_eval_step(model, groups=G))
+        want = jax.device_get(grouped(params, stacked))
+        sharding = NamedSharding(mesh, P("data"))
+        placed = {k: jax.device_put(v, sharding) for k, v in stacked.items()}
+        rep_params = jax.device_put(params, NamedSharding(mesh, P()))
+        got = jax.device_get(grouped(rep_params, placed))
+        np.testing.assert_allclose(got["loss"], want["loss"], rtol=1e-6)
+        np.testing.assert_allclose(got["dice"], want["dice"], rtol=1e-6)
+
+    def test_evaluate_sharded_world1_matches_evaluate(self, model, params):
+        """world == 1 short-circuits to the plain per-batch loop."""
+        from distributedpytorch_tpu.data import (
+            DataLoader,
+            SyntheticSegmentationDataset,
+        )
+        from distributedpytorch_tpu.data.loader import ShardSpec
+        from distributedpytorch_tpu.evaluate import evaluate, evaluate_sharded
+        from distributedpytorch_tpu.train.steps import make_eval_step
+
+        ds = SyntheticSegmentationDataset(length=10, newsize=(W, H), seed=0)
+        loader = DataLoader(ds, batch_size=4, drop_last=True)
+        step = jax.jit(make_eval_step(model))
+        want = evaluate(step, params, loader)
+        got = evaluate_sharded(
+            step, step, params, loader, None, ShardSpec(0, 1)
+        )
+        assert got == want

@@ -1,0 +1,81 @@
+#!/usr/bin/env python3
+"""TPU runtime health check → committed artifact (round hygiene).
+
+VERDICT r03 next-1b: the driver's bench capture ran against a runtime some
+earlier process had wedged, three rounds running. This tool is the round's
+last TPU action: probe the runtime with a trivial computation in a
+subprocess (bench.py's probe — SIGTERM-only, never SIGKILL), list any
+leftover processes that might still hold the device, and write the result
+to ``TPU_HEALTH.json`` so the round's final commit records the state the
+chip was left in.
+
+Usage: ``python tools/tpu_health.py [--out TPU_HEALTH.json] [--timeout 240]``
+Exit 0 if the probe succeeded, 1 otherwise (the artifact is written either
+way).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import _probe_once  # noqa: E402  (SIGTERM-only subprocess probe)
+
+
+def _suspect_processes() -> list:
+    """Python processes (other than us and our probe) that could be holding
+    the tunneled runtime — recorded, not killed: killing is how wedges
+    happen; the operator decides."""
+    try:
+        out = subprocess.run(
+            ["ps", "-eo", "pid,etimes,args"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout
+    except Exception:
+        return []
+    me = os.getpid()
+    suspects = []
+    for line in out.splitlines()[1:]:
+        parts = line.split(None, 2)
+        if len(parts) < 3:
+            continue
+        pid, etimes, args = parts
+        if "python" not in args or int(pid) in (me,):
+            continue
+        if any(k in args for k in ("bench.py", "train.py", "dpt-", "jax",
+                                   "distributedpytorch", "_PROBE", "tpu_health")):
+            suspects.append({"pid": int(pid), "age_s": int(etimes),
+                             "cmd": args[:160]})
+    return suspects
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="TPU_HEALTH.json")
+    ap.add_argument("--timeout", type=float, default=240.0)
+    args = ap.parse_args()
+
+    result = _probe_once(args.timeout)
+    artifact = {
+        "checked_at": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"
+        ),
+        "probe": result,
+        "healthy": bool(result.get("ok")),
+        "leftover_processes": _suspect_processes(),
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+        f.write("\n")
+    print(json.dumps(artifact))
+    return 0 if artifact["healthy"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
