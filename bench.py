@@ -129,11 +129,11 @@ def _probe_once(timeout: float) -> dict:
     proc = subprocess.Popen(
         [sys.executable, "-u", "-c", _PROBE_SRC],
         stdout=subprocess.PIPE,
-        stderr=subprocess.DEVNULL,
+        stderr=subprocess.PIPE,
         text=True,
     )
     try:
-        out, _ = proc.communicate(timeout=timeout)
+        out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
         proc.terminate()
         try:
@@ -149,10 +149,14 @@ def _probe_once(timeout: float) -> dict:
     try:
         return json.loads(line)
     except (ValueError, IndexError):
+        # a FAST failure is an environment bug, not a wedged runtime —
+        # surface the child's actual traceback so the artifact can tell
+        # the two apart
         return {
             "ok": False,
             "error": f"probe rc={proc.returncode}, unparseable output "
                      f"{line[:120]!r}",
+            "stderr_tail": (err or "").strip()[-400:],
         }
 
 

@@ -336,12 +336,12 @@ class UNet(nn.Module):
         return self.decode_head(x, skips)
 
     # -- pipeline stage boundaries (reference unet_model.py:16-20 cut) -----
-    def encode_mid(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
-        """Stage 0 of the 2-stage pipeline: encoder + mid block."""
+    def _check_s2d_size(self, x: jax.Array) -> None:
+        """The pixel path degrades gracefully on ragged sizes via the
+        decoder's center-crop; the s2d path cannot — fail fast with the
+        workaround instead of asserting deep in the first step. Called at
+        every model entry (full forward, 2-stage cut, segment 0)."""
         if self._s2d_levels() > 0:
-            # The pixel path degrades gracefully on ragged sizes via the
-            # decoder's center-crop; the s2d path cannot — fail fast with
-            # the workaround instead of asserting deep in the first step.
             div = 2 ** len(self.widths)
             h, w = x.shape[1], x.shape[2]
             if h % div or w % div:
@@ -351,6 +351,10 @@ class UNet(nn.Module):
                     f"requires — resize the input or pass s2d_levels=0 "
                     f"(CLI: --s2d-levels 0)"
                 )
+
+    def encode_mid(self, x: jax.Array) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Stage 0 of the 2-stage pipeline: encoder + mid block."""
+        self._check_s2d_size(x)
         x, skips = self.encoder(x)
         x = self.mid(x)
         return x, skips
@@ -390,6 +394,8 @@ class UNet(nn.Module):
         payload at any cut is exactly this carry.
         """
         L = len(self.widths)
+        if seg == 0:
+            self._check_s2d_size(x)
         if seg < L:  # encoder level
             x, skip = self.encoder.level(x, seg)
             return x, tuple(skips) + (skip,)

@@ -88,6 +88,27 @@ class Strategy:
         return jax.device_put(state, dev)
 
     # -- compiled steps -----------------------------------------------------
+    def _train_loss_impl(self) -> Optional[Callable]:
+        """The fused Pallas training loss under ``--pallas`` (None = XLA
+        loss). Single-device runs use the kernel directly; mesh strategies
+        wrap it in shard_map — per-shard kernel + a 4-scalar stats psum
+        over the batch-sharding axes — so the loss and its custom-VJP
+        gradient equal the unsharded computation (ops/fused_loss.py; this
+        replaces round 3's gate-it-off-on-meshes behavior, VERDICT r03
+        next-5)."""
+        if not self.config.use_pallas:
+            return None
+        from distributedpytorch_tpu.ops.fused_loss import (
+            fused_bce_dice_loss,
+            make_sharded_fused_loss,
+            spec_axes,
+        )
+
+        if self.mesh is None:
+            return fused_bce_dice_loss
+        spec = self.batch_sharding.spec
+        return make_sharded_fused_loss(self.mesh, spec, spec_axes(spec))
+
     def _raw_step(self, model, tx) -> Callable:
         """The unjitted per-batch step this strategy runs (overridden by
         pipeline strategies, which schedule stages inside the step)."""
@@ -100,6 +121,7 @@ class Strategy:
             batch_size=self.config.batch_size,
             faithful_loss_scaling=self.config.faithful_loss_scaling,
             remat=self.config.remat,
+            loss_impl=self._train_loss_impl(),
         )
 
     def build_train_step(self, model, tx) -> Callable:
@@ -147,24 +169,37 @@ class Strategy:
         train batch; returns per-batch vector metrics (see
         train/steps.grouped_eval_metrics). Every process reads back
         identical values, so the plateau scheduler stays in lockstep while
-        each process loads and computes only 1/world of the val set."""
-        return jax.jit(make_eval_step(model, groups=self.eval_shard().world))
+        each process loads and computes only 1/world of the val set.
+
+        Output shardings are pinned REPLICATED: left to itself GSPMD may
+        shard the (world,) metric vectors over 'data' (one element per
+        shard — exactly the layout), which multi-process hosts cannot
+        device_get (elements live on non-addressable devices)."""
+        step = make_eval_step(model, groups=self.eval_shard().world)
+        if self.mesh is not None:
+            replicated = NamedSharding(self.mesh, P())
+            return jax.jit(
+                step, out_shardings={"loss": replicated, "dice": replicated}
+            )
+        return jax.jit(step)
 
     def _pallas_eval(self) -> bool:
-        """`use_pallas` applies only where the eval batch is unsharded
+        """`use_pallas` EVAL applies only where the eval batch is unsharded
         (single device / replicated): pallas_call has no GSPMD partitioning
         rule, so a mesh-sharded (B,H,W,1) input would fail to lower or
-        force a de-shard. Sharded strategies fall back to the XLA loss,
-        loudly."""
+        force a de-shard. Sharded strategies keep the XLA eval metrics —
+        the TRAINING loss still runs the fused kernel via the shard_map
+        wrapper (`_train_loss_impl`), so only the per-epoch eval pass
+        differs."""
         if not self.config.use_pallas:
             return False
         if self.mesh is not None:
             import logging
 
-            logging.getLogger(__name__).warning(
-                "--pallas: the fused eval-loss kernel runs only on "
-                "unsharded eval batches; strategy %s evaluates through a "
-                "mesh, keeping the XLA loss path",
+            logging.getLogger(__name__).info(
+                "--pallas: strategy %s trains through the fused kernel "
+                "(shard_map); eval metrics stay on the XLA path (sharded "
+                "eval batches cannot enter pallas_call)",
                 self.name,
             )
             return False
@@ -342,6 +377,7 @@ class Pipeline(Strategy):
             data_axis=None,
             remat=self.config.remat,
             cuts=self.config.pipeline_cuts,
+            use_pallas=self.config.use_pallas,
         )
 
     def _raw_step(self, model, tx) -> Callable:
@@ -445,6 +481,7 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
             data_axis="data",
             remat=self.config.remat,
             cuts=self.config.pipeline_cuts,
+            use_pallas=self.config.use_pallas,
         )
 
     def build_eval_step(self, model) -> Callable:
@@ -482,7 +519,10 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
             preds = fwd(params, batch["image"])
             return grouped_eval_metrics(preds, _prep_mask(batch["mask"]), groups)
 
-        return jax.jit(eval_step)
+        replicated = NamedSharding(self.mesh, P())
+        return jax.jit(
+            eval_step, out_shardings={"loss": replicated, "dice": replicated}
+        )
 
 
 class SpatialParallel(DataParallel):

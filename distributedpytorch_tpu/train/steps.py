@@ -76,6 +76,33 @@ def loss_fn(model, params, batch: Dict[str, jax.Array]) -> jax.Array:
     return bce_dice_loss(preds, _prep_mask(batch["mask"]))
 
 
+def _make_loss_fns(loss_impl):
+    """The (pure, stateful) loss pair with a pluggable ``loss_impl(preds,
+    target) -> loss`` — the strategy's hook for routing the training loss
+    through the fused Pallas kernel (ops/fused_loss.py); None keeps the
+    XLA loss."""
+    if loss_impl is None:
+        return loss_fn, stateful_loss_fn
+
+    def custom_loss_fn(model, params, batch):
+        preds = model.apply({"params": params}, batch["image"])
+        return loss_impl(preds, _prep_mask(batch["mask"]))
+
+    def custom_stateful_loss_fn(model, params, model_state, batch):
+        preds, updates = model.apply(
+            {"params": params, "batch_stats": model_state},
+            batch["image"],
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return (
+            loss_impl(preds, _prep_mask(batch["mask"])),
+            updates["batch_stats"],
+        )
+
+    return custom_loss_fn, custom_stateful_loss_fn
+
+
 def _is_stateful(model) -> bool:
     """Models that carry non-trainable collections (BatchNorm running
     stats) declare ``is_stateful = True`` (models/milesial.py)."""
@@ -104,6 +131,7 @@ def make_train_step(
     batch_size: int,
     faithful_loss_scaling: bool = True,
     remat: bool = False,
+    loss_impl: Callable = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
     """Build the (unjitted) train step; the strategy decides how to jit/shard
     it. Returns ``step(state, batch) -> (state, unscaled_loss)``.
@@ -112,11 +140,16 @@ def make_train_step(
     (jax.checkpoint): activations are recomputed instead of stored, cutting
     peak HBM roughly in half for ~1/3 more FLOPs — the TPU-native answer to
     the reference's 7.8 GB-at-batch-4 VRAM wall (modelsummary.txt:72).
+
+    `loss_impl` swaps the loss computation (default: the XLA
+    `bce_dice_loss`); strategies pass the fused Pallas loss under
+    ``--pallas`` (Strategy._train_loss_impl).
     """
 
     grad_scale = float(batch_size) if faithful_loss_scaling else 1.0
     stateful = _is_stateful(model)
-    raw_fwd = stateful_loss_fn if stateful else loss_fn
+    pure_fn, stateful_fn = _make_loss_fns(loss_impl)
+    raw_fwd = stateful_fn if stateful else pure_fn
     fwd = jax.checkpoint(raw_fwd, static_argnums=(0,)) if remat else raw_fwd
 
     def train_step(state: TrainState, batch: Dict[str, jax.Array]):

@@ -363,6 +363,21 @@ class TestStrategySteps:
         np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-6, atol=1e-7)
         _tree_allclose(ref_params, got_params, rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.parametrize("method", ["singleGPU", "DP", "MP"])
+    def test_pallas_training_loss_matches(self, method, model, params, batch,
+                                          single_result):
+        """--pallas routes the TRAINING loss through the fused kernel +
+        custom VJP (direct, shard_map-wrapped, and inside the pipeline
+        schedule respectively) — one Adam step must land where the XLA
+        loss does (VERDICT r03 next-5)."""
+        cfg = _config(method, use_pallas=True,
+                      ddp_lr_world_size_scaling=False)
+        strat = build_strategy(cfg)
+        got_params, got_loss = self._stepped_params(strat, model, params, batch, cfg)
+        ref_params, ref_loss = single_result
+        np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-5, atol=1e-6)
+        _tree_allclose(ref_params, got_params, rtol=5e-4, atol=3e-4)
+
     def test_dp_mesh_shrink_warns(self, caplog):
         """An indivisible batch shrinks the data mesh — loudly (VERDICT r03
         missing-3: the silent shrink left devices idle with no trace)."""
