@@ -211,7 +211,11 @@ def run() -> dict:
         make_train_step,
     )
 
-    model = UNet(dtype=jnp.bfloat16)
+    # A/B levers for on-chip experiments (default = shipping config)
+    model = UNet(
+        dtype=jnp.bfloat16,
+        wgrad_taps=os.environ.get("BENCH_WGRAD_TAPS") == "1",
+    )
     params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
     state, tx = create_train_state(params, 1e-4)
 

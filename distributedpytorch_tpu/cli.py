@@ -81,6 +81,10 @@ def get_args():
                              "space-to-depth domain (exact numerics, ~1.9x "
                              "faster on TPU); 0 disables, -1 = auto "
                              "(2 on TPU, 0 elsewhere)")
+    parser.add_argument("--wgrad-taps", action="store_true",
+                        help="Weight gradients of the s2d 3x3 convs as 9 "
+                             "tap matmuls instead of XLA's conv backward "
+                             "(identical numerics; perf A/B lever)")
     parser.add_argument("--model", dest="model_arch", type=str, default="unet",
                         choices=["unet", "milesial"],
                         help="Model family: the reference course UNet "
@@ -161,6 +165,7 @@ def main():
         model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
         s2d_levels=args.s2d_levels,
+        wgrad_taps=args.wgrad_taps,
         checkpoint_name=resolve_checkpoint_arg(args),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,

@@ -83,6 +83,11 @@ class TrainConfig:
     # (the rewrite's 4× nominal MACs only pay off on the MXU).
     # 0 = plain pixel-domain execution.
     s2d_levels: int = -1
+    # Compute the s2d 3×3 convs' weight gradients as 9 tap matmuls
+    # (ops/conv_backward.py) instead of XLA's conv-backward-filter —
+    # identical numerics (tests/test_s2d.py), different schedule. The
+    # round-3 step was backward-dominated; this is the A/B lever.
+    wgrad_taps: bool = False
 
     @property
     def model_levels(self) -> int:

@@ -29,7 +29,10 @@ def create_model(config):
 
         widths = tuple(config.model_widths) if config.model_widths else MILESIAL_WIDTHS
         model = MilesialUNet(
-            widths=widths, dtype=jnp.dtype(config.compute_dtype)
+            widths=widths,
+            dtype=jnp.dtype(config.compute_dtype),
+            s2d_levels=getattr(config, "s2d_levels", -1),
+            wgrad_taps=getattr(config, "wgrad_taps", False),
         )
 
         def init_fn(rng, input_hw):

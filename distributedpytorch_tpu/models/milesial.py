@@ -30,15 +30,160 @@ TPU notes:
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from distributedpytorch_tpu.models.unet import center_crop
+from distributedpytorch_tpu.models.unet import _S2DConv, center_crop
+from distributedpytorch_tpu.ops import s2d as s2d_ops
 
 MILESIAL_WIDTHS = (64, 128, 256, 512, 1024)
+
+
+class _S2DBatchNorm(nn.Module):
+    """BatchNorm evaluated on a g-major space-to-depth tensor, EXACTLY
+    equal to pixel-domain BatchNorm (up to reduction order): channel c of
+    the underlying (B, H, W, C) image lives at s2d channels {g·C+c}, so
+    per-logical-channel statistics reduce over (batch, h, w, g) — the
+    same value set pixel BN reduces over (batch, H, W). Parameters and
+    running statistics are (C,)-shaped with nn.BatchNorm's names, so
+    checkpoints and `.pth` interop are identical across execution modes
+    (the s2d contract, ops/s2d.py).
+
+    Matches the pixel path's nn.BatchNorm config (milesial: momentum 0.9
+    flax-convention, eps 1e-5, float32 statistics).
+    """
+
+    features: int  # logical channels C (input carries 4C)
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        C = self.features
+        scale = self.param("scale", nn.initializers.ones_init(), (C,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (C,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((C,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((C,), jnp.float32)
+        )
+        b, h, w, c4 = x.shape
+        assert c4 == 4 * C, (c4, C)
+        xg = x.astype(jnp.float32).reshape(b, h, w, 4, C)
+        if train:
+            mean = jnp.mean(xg, axis=(0, 1, 2, 3))
+            var = jnp.var(xg, axis=(0, 1, 2, 3))
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1.0 - self.momentum) * var
+                )
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        y = (xg - mean) * jax.lax.rsqrt(var + self.epsilon) * scale + bias
+        return y.reshape(b, h, w, c4)
+
+
+class DoubleConvS2D(nn.Module):
+    """`DoubleConv` in the space-to-depth domain: bias-free structured
+    dense convs (kernels assembled from the original (3,3,Cin,Cout)
+    params) + exact s2d BatchNorm. Param tree identical to `DoubleConv`
+    (conv1/bn1/conv2/bn2, same shapes)."""
+
+    features: int
+    in_features: int
+    in_segments: Optional[Tuple[int, ...]] = None
+    dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = _S2DConv(
+            self.features, self.in_features, "conv3x3", dtype=self.dtype,
+            in_segments=self.in_segments, wgrad_taps=self.wgrad_taps,
+            use_bias=False, name="conv1",
+        )(x)
+        x = _S2DBatchNorm(self.features, name="bn1")(x, train)
+        x = nn.relu(x).astype(self.dtype)
+        x = _S2DConv(
+            self.features, self.features, "conv3x3", dtype=self.dtype,
+            wgrad_taps=self.wgrad_taps, use_bias=False, name="conv2",
+        )(x)
+        x = _S2DBatchNorm(self.features, name="bn2")(x, train)
+        return nn.relu(x).astype(self.dtype)
+
+
+class _DownS2D(nn.Module):
+    """`Down` where the s2d execution domain touches either side of the
+    pool: the 2×2 maxpool of an s2d input is a max over the s2d group
+    (ops/s2d.py `group_max`), and the conv runs in whichever domain its
+    level belongs to. Param tree identical to `Down`."""
+
+    features: int
+    in_features: int
+    prev_s2d: bool  # input arrives in s2d form
+    this_s2d: bool  # this level's DoubleConv runs in the s2d domain
+    dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        x = (
+            s2d_ops.group_max(x)
+            if self.prev_s2d
+            else nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
+        )
+        if self.this_s2d:
+            x = s2d_ops.space_to_depth(x)
+            return DoubleConvS2D(
+                self.features, in_features=self.in_features,
+                dtype=self.dtype, wgrad_taps=self.wgrad_taps, name="conv",
+            )(x, train)
+        return DoubleConv(self.features, dtype=self.dtype, name="conv")(x, train)
+
+
+class _UpS2D(nn.Module):
+    """`Up` (transposed-conv mode) in the s2d domain: the k=2 s=2
+    ConvTranspose becomes a 1×1 conv from the pixel-space input
+    (ops/s2d.py `upconv_kernel`), the skip arrives already in s2d form,
+    and the concat is a kernel-layout concern (`in_segments`). Param tree
+    identical to `Up(bilinear=False)`."""
+
+    features: int
+    skip_features: int
+    prev_s2d: bool  # x arrives in s2d form (previous Up ran s2d)
+    dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, skip: jax.Array, train: bool = False
+    ) -> jax.Array:
+        if self.prev_s2d:
+            x = s2d_ops.depth_to_space(x)
+        up_feats = x.shape[-1] // 2
+        up = _S2DConv(
+            up_feats, x.shape[-1], "upconv", dtype=self.dtype, name="up"
+        )(x)
+        assert skip.shape[:3] == up.shape[:3], (
+            "s2d Up expects the identity center-crop (even input sizes): "
+            f"skip {skip.shape} vs upconv {up.shape}"
+        )
+        x = jnp.concatenate([skip, up], axis=-1)
+        return DoubleConvS2D(
+            self.features,
+            in_features=self.skip_features + up_feats,
+            in_segments=(self.skip_features, up_feats),
+            dtype=self.dtype,
+            wgrad_taps=self.wgrad_taps,
+            name="conv",
+        )(x, train)
 
 
 class DoubleConv(nn.Module):
@@ -115,37 +260,126 @@ class Up(nn.Module):
 
 
 class MilesialUNet(nn.Module):
-    """inc → Down×4 → Up×4 → OutConv (reference modelsummary.txt:150-247)."""
+    """inc → Down×4 → Up×4 → OutConv (reference modelsummary.txt:150-247).
+
+    ``s2d_levels`` executes the shallowest levels in the space-to-depth
+    domain (ops/s2d.py), exactly like models/unet.py's flagship model —
+    level 0 is the full-resolution `inc` stem (64 channels at 640×960:
+    the same MXU-starving shape the course model's s2d rewrite attacks),
+    level i is `down_i`. BatchNorm statistics stay exact via
+    `_S2DBatchNorm` (reduced over the s2d group axis as well as
+    batch × space). -1 = auto (2 on TPU, 0 elsewhere); requires
+    ``bilinear=False`` (the documented 31M config) and input sizes
+    divisible by 2**levels.
+    """
 
     n_classes: int = 1
     bilinear: bool = False
     widths: Sequence[int] = MILESIAL_WIDTHS
     dtype: Any = jnp.bfloat16
+    s2d_levels: int = -1
+    wgrad_taps: bool = False
 
     # train/steps.py keys off this to thread the batch_stats collection
     is_stateful = True
+
+    def _s2d_levels(self) -> int:
+        auto = self.s2d_levels < 0
+        lv = (2 if jax.default_backend() == "tpu" else 0) if auto else self.s2d_levels
+        lv = max(0, min(lv, len(self.widths) - 2))
+        if lv > 0 and self.bilinear:
+            if auto:  # auto never breaks a previously-valid config
+                return 0
+            raise ValueError(
+                "s2d execution supports the transposed-conv decoder only "
+                "(bilinear=False) — pass s2d_levels=0 with bilinear"
+            )
+        return lv
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         w = tuple(self.widths)
         assert len(w) >= 2, "milesial needs at least inc + one Down level"
         factor = 2 if self.bilinear else 1
-        x = DoubleConv(w[0], dtype=self.dtype, name="inc")(x, train)
+        lv = self._s2d_levels()
+        if lv > 0:
+            div = 2 ** (len(w) - 1)
+            h_, w_ = x.shape[1], x.shape[2]
+            if h_ % div or w_ % div:
+                if self.s2d_levels < 0:
+                    # auto mode degrades to the (center-crop-tolerant)
+                    # pixel path rather than rejecting a size the model
+                    # handled before s2d existed
+                    lv = 0
+                else:
+                    raise ValueError(
+                        f"input {h_}×{w_} is not divisible by {div} "
+                        f"(2**levels), which the space-to-depth execution "
+                        f"mode requires — resize the input or pass "
+                        f"s2d_levels=0 (CLI: --s2d-levels 0)"
+                    )
+
+        n_downs = len(w) - 1  # also the number of Ups
+        if lv > 0:
+            xs = s2d_ops.space_to_depth(x)
+            x = DoubleConvS2D(
+                w[0], in_features=x.shape[-1], dtype=self.dtype,
+                wgrad_taps=self.wgrad_taps, name="inc",
+            )(xs, train)
+        else:
+            x = DoubleConv(w[0], dtype=self.dtype, name="inc")(x, train)
         skips = [x]
         for i, feats in enumerate(w[1:-1]):
-            x = Down(feats, dtype=self.dtype, name=f"down{i + 1}")(x, train)
+            level = i + 1
+            if level < lv or (level == lv and lv > 0):
+                # s2d level, or the boundary Down whose pool consumes an
+                # s2d input (group_max) but convs in the pixel domain
+                x = _DownS2D(
+                    feats, in_features=w[level - 1],
+                    prev_s2d=level - 1 < lv, this_s2d=level < lv,
+                    dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                    name=f"down{level}",
+                )(x, train)
+            else:
+                x = Down(feats, dtype=self.dtype, name=f"down{level}")(x, train)
             skips.append(x)
-        x = Down(w[-1] // factor, dtype=self.dtype, name=f"down{len(w) - 1}")(
-            x, train
-        )
+        last = len(w) - 1
+        if last == lv and lv > 0:
+            x = _DownS2D(
+                w[-1] // factor, in_features=w[last - 1],
+                prev_s2d=True, this_s2d=False,
+                dtype=self.dtype, name=f"down{last}",
+            )(x, train)
+        else:
+            x = Down(w[-1] // factor, dtype=self.dtype, name=f"down{last}")(
+                x, train
+            )
         for i, (feats, skip) in enumerate(zip(reversed(w[:-1]), reversed(skips))):
-            x = Up(
-                feats // (factor if i < len(w) - 2 else 1),
-                bilinear=self.bilinear,
-                dtype=self.dtype,
-                name=f"up{i + 1}",
-            )(x, skip, train)
-        x = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype, name="outc")(x)
+            out_feats = feats // (factor if i < len(w) - 2 else 1)
+            if i >= n_downs - lv:
+                # shallowest lv Ups: skip is s2d-form, output stays s2d
+                x = _UpS2D(
+                    out_feats,
+                    skip_features=w[n_downs - 1 - i],
+                    prev_s2d=i - 1 >= n_downs - lv,
+                    dtype=self.dtype,
+                    wgrad_taps=self.wgrad_taps,
+                    name=f"up{i + 1}",
+                )(x, skip, train)
+            else:
+                x = Up(
+                    out_feats,
+                    bilinear=self.bilinear,
+                    dtype=self.dtype,
+                    name=f"up{i + 1}",
+                )(x, skip, train)
+        if lv > 0:
+            x = _S2DConv(
+                self.n_classes, w[0], "head", dtype=self.dtype, name="outc"
+            )(x)
+            x = s2d_ops.depth_to_space(x)
+        else:
+            x = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype, name="outc")(x)
         if self.n_classes == 1:
             return jax.nn.sigmoid(x.astype(jnp.float32))
         return x.astype(jnp.float32)

@@ -1,0 +1,72 @@
+"""Alternative conv backward: weight-grad as 9 tap matmuls.
+
+Round-3 profiling (docs/PERFORMANCE.md) left the step backward-dominated:
+the s2d-domain 3×3 convs run their BACKWARD at ~2.1× the forward's time,
+i.e. XLA's conv-backward-filter emitter schedules no better than the
+forward even though the weight gradient is just a tall contraction
+
+    dW[ky,kx,ci,co] = Σ_{b,y,x} Xpad[b, y+ky, x+kx, ci] · dY[b, y, x, co]
+
+— for the hot 128→128 @ 320×480 batch-4 shape: M = Cin = 128,
+N = Cout = 128, K = B·H·W ≈ 614k per tap. This module re-expresses that
+weight gradient as 9 explicit `einsum`s (one per kernel tap, each a plain
+MXU matmul over a shifted view of the padded input) behind a
+`jax.custom_vjp`, leaving the forward and the input-gradient on XLA's
+conv emitter (the input-grad IS a conv — of dY with the rot180,
+in/out-swapped kernel — and XLA runs convs at forward speed).
+
+Numerics: the taps accumulate in float32 (`preferred_element_type`) and
+cast back to the kernel dtype, the same contract as XLA's bf16 conv
+backward; exactness vs `jax.grad` of the plain conv is pinned in
+tests/test_s2d.py. Off by default (`TrainConfig.wgrad_taps`) until the
+TPU measurement lands — this is a hypothesis with a test harness, not a
+claimed win.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from distributedpytorch_tpu.ops.s2d import conv_same as _conv_same
+
+
+@jax.custom_vjp
+def conv3x3_same_taps(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """NHWC SAME stride-1 3×3 conv; forward = XLA conv, backward =
+    XLA conv for dx + 9 tap matmuls for dW."""
+    return _conv_same(x, kernel)
+
+
+def _fwd(x, kernel):
+    return _conv_same(x, kernel), (x, kernel)
+
+
+def _bwd(res, dy):
+    x, kernel = res
+    # dx: SAME conv of dY with the rotated, in/out-swapped kernel —
+    # kt[ky,kx,co,ci] = k[2−ky, 2−kx, ci, co] (exact for stride-1 SAME).
+    kt = kernel[::-1, ::-1].transpose(0, 1, 3, 2)
+    dx = _conv_same(dy, kt)
+
+    b, h, w, _ = x.shape
+    xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
+    taps = []
+    for ky in range(3):
+        for kx in range(3):
+            win = jax.lax.slice(
+                xp, (0, ky, kx, 0), (b, ky + h, kx + w, x.shape[3])
+            )
+            taps.append(
+                jnp.einsum(
+                    "bhwi,bhwo->io",
+                    win,
+                    dy,
+                    preferred_element_type=jnp.float32,
+                )
+            )
+    dk = jnp.stack(taps).reshape(3, 3, x.shape[3], kernel.shape[3])
+    return dx.astype(x.dtype), dk.astype(kernel.dtype)
+
+
+conv3x3_same_taps.defvjp(_fwd, _bwd)

@@ -261,3 +261,137 @@ def test_steps_per_dispatch_with_stateful_model(tmp_path):
         tmp_path, "singleGPU", model_arch="milesial", model_widths=(4, 8),
         image_size=(8, 8), epochs=1,
     )
+
+
+class TestMilesialS2D:
+    """Space-to-depth execution for the milesial family (round-4): same
+    params, same function — including EXACT BatchNorm statistics reduced
+    over the s2d group axis (_S2DBatchNorm)."""
+
+    # 4 widths: _s2d_levels clamps to len(widths)-2, so 3 widths would
+    # silently run every "lv=2" test at lv=1, skipping the deep branches
+    # (_DownS2D this_s2d, _UpS2D prev_s2d d2s, the last==lv boundary)
+    WIDTHS = (4, 8, 16, 32)
+    HW = (16, 24)
+
+    def _setup(self, s2d):
+        model = MilesialUNet(
+            widths=self.WIDTHS, dtype=jnp.float32, s2d_levels=s2d
+        )
+        params, stats = init_milesial(
+            model, jax.random.key(0), input_hw=self.HW
+        )
+        return model, params, stats
+
+    def test_param_tree_identical(self):
+        _, p0, s0 = self._setup(0)
+        _, p2, s2 = self._setup(2)
+        assert jax.tree_util.tree_structure(p0) == jax.tree_util.tree_structure(p2)
+        assert jax.tree_util.tree_structure(s0) == jax.tree_util.tree_structure(s2)
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(p2)):
+            assert a.shape == b.shape
+
+    @pytest.mark.parametrize("s2d", [1, 2])
+    def test_eval_forward_matches_pixel(self, s2d):
+        m0, params, stats = self._setup(0)
+        m2 = MilesialUNet(widths=self.WIDTHS, dtype=jnp.float32, s2d_levels=s2d)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.random((2, *self.HW, 3), dtype=np.float32))
+        v = {"params": params, "batch_stats": stats}
+        want = m0.apply(v, x, train=False)
+        got = m2.apply(v, x, train=False)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+
+    def test_train_forward_and_stats_match_pixel(self):
+        """train=True: batch statistics computed over (batch, space, s2d
+        group) must equal pixel-domain batch statistics, and so must the
+        updated running stats."""
+        m0, params, stats = self._setup(0)
+        m2 = MilesialUNet(widths=self.WIDTHS, dtype=jnp.float32, s2d_levels=2)
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.random((2, *self.HW, 3), dtype=np.float32))
+        v = {"params": params, "batch_stats": stats}
+        want, upd0 = m0.apply(v, x, train=True, mutable=["batch_stats"])
+        got, upd2 = m2.apply(v, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-6
+        )
+        for a, b in zip(
+            jax.tree.leaves(upd0["batch_stats"]),
+            jax.tree.leaves(upd2["batch_stats"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-5, atol=2e-6
+            )
+
+    def test_grads_match_pixel(self):
+        """float64 (subprocess: x64 is a process-wide jax config): the two
+        execution domains are mathematically the SAME function, so
+        gradients agree to ~1e-6 relative. (In float32 the BatchNorm
+        backward amplifies summation-order noise to ~1e-2 on the earliest
+        layers — measured identically ill-conditioned for both paths, so
+        f32 equality is not the right assertion.)"""
+        import os
+        import subprocess
+        import sys
+
+        script = """
+import jax, jax.numpy as jnp, numpy as np
+from distributedpytorch_tpu.models.milesial import MilesialUNet, init_milesial
+from distributedpytorch_tpu.ops.losses import bce_dice_loss
+W, HW = (4, 8, 16, 32), (16, 24)
+m0 = MilesialUNet(widths=W, dtype=jnp.float64, s2d_levels=0)
+m2 = MilesialUNet(widths=W, dtype=jnp.float64, s2d_levels=2)
+params, stats = init_milesial(m0, jax.random.key(0), input_hw=HW)
+params = jax.tree.map(lambda a: a.astype(jnp.float64), params)
+stats = jax.tree.map(lambda a: a.astype(jnp.float64), stats)
+rng = np.random.default_rng(3)
+x = jnp.asarray(rng.random((2, *HW, 3)), jnp.float64)
+t = jnp.asarray((rng.random((2, *HW, 1)) > 0.5), jnp.float64)
+def grads(m):
+    def f(p):
+        preds, _ = m.apply({"params": p, "batch_stats": stats}, x,
+                           train=True, mutable=["batch_stats"])
+        return bce_dice_loss(preds, t)
+    return jax.grad(f)(params)
+g0, g2 = grads(m0), grads(m2)
+for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g2)):
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                               rtol=1e-4, atol=1e-7)
+print("GRADS-MATCH")
+"""
+        env = dict(os.environ)
+        env.update({
+            "JAX_ENABLE_X64": "1",
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+        })
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", script], env=env, cwd=repo,
+            capture_output=True, text=True, timeout=600,
+        )
+        assert out.returncode == 0 and "GRADS-MATCH" in out.stdout, (
+            out.stdout + out.stderr
+        )
+
+    def test_auto_mode_degrades_gracefully(self):
+        """-1 (auto) must never reject a config the pixel path handled:
+        bilinear and ragged sizes silently fall back to pixel."""
+        m = MilesialUNet(widths=self.WIDTHS, dtype=jnp.float32,
+                         bilinear=True, s2d_levels=-1)
+        m.init(jax.random.key(0), jnp.zeros((1, *self.HW, 3)))
+        m2 = MilesialUNet(widths=self.WIDTHS, dtype=jnp.float32, s2d_levels=-1)
+        m2.init(jax.random.key(0), jnp.zeros((1, 18, 26, 3)))
+
+    def test_bilinear_rejects_s2d(self):
+        m = MilesialUNet(widths=self.WIDTHS, bilinear=True, s2d_levels=2)
+        with pytest.raises(ValueError, match="bilinear"):
+            m.init(jax.random.key(0), jnp.zeros((1, *self.HW, 3)))
+
+    def test_ragged_size_rejects_s2d(self):
+        m = MilesialUNet(widths=self.WIDTHS, dtype=jnp.float32, s2d_levels=2)
+        with pytest.raises(ValueError, match="divisible"):
+            m.init(jax.random.key(0), jnp.zeros((1, 18, 24, 3)))
