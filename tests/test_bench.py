@@ -1,0 +1,66 @@
+"""bench.py's pre-flight machinery — the path that decides whether the
+driver's one trusted artifact carries a number or an excuse (VERDICT r03
+next-1). Probes run real subprocesses against the CPU backend here."""
+
+import json
+import time
+
+import pytest
+
+import bench
+import tools.tpu_health as tpu_health
+
+
+def test_probe_once_ok():
+    result = bench._probe_once(timeout=120)
+    assert result["ok"] is True
+    assert result["platform"] == "cpu"  # conftest forces the CPU backend
+    assert result["secs"] < 120
+
+
+def test_probe_once_timeout(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import time; time.sleep(60)")
+    t0 = time.monotonic()
+    result = bench._probe_once(timeout=1)
+    assert result["ok"] is False
+    assert "timeout" in result["error"]
+    # SIGTERM killed the sleeper within the grace window
+    assert time.monotonic() - t0 < 35
+
+
+def test_probe_once_env_bug_carries_stderr(monkeypatch):
+    monkeypatch.setattr(
+        bench, "_PROBE_SRC", "raise ImportError('jax exploded')"
+    )
+    result = bench._probe_once(timeout=60)
+    assert result["ok"] is False
+    assert "jax exploded" in result.get("stderr_tail", "")
+
+
+def test_preflight_success_first_try():
+    ok, history = bench._preflight(time.monotonic() + 300)
+    assert ok is True
+    assert len(history) == 1
+
+
+def test_preflight_respects_deadline(monkeypatch):
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import sys; sys.exit(1)")
+    deadline = time.monotonic() + 35
+    ok, history = bench._preflight(deadline)
+    assert ok is False
+    assert len(history) >= 1
+    assert time.monotonic() <= deadline + 5
+
+
+def test_tpu_health_artifact(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(
+        "sys.argv", ["tpu_health", "--out", str(tmp_path / "h.json"),
+                     "--timeout", "120"],
+    )
+    rc = tpu_health.main()
+    assert rc == 0
+    artifact = json.loads((tmp_path / "h.json").read_text())
+    assert artifact["healthy"] is True
+    assert artifact["probe"]["platform"] == "cpu"
+    # the stdout line is the same JSON (driver-visible)
+    assert json.loads(capsys.readouterr().out)["healthy"] is True
