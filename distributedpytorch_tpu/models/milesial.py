@@ -36,7 +36,11 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from distributedpytorch_tpu.models.unet import _S2DConv, center_crop
+from distributedpytorch_tpu.models.unet import (
+    _S2DConv,
+    _TapsPixelConv,
+    center_crop,
+)
 from distributedpytorch_tpu.ops import s2d as s2d_ops
 
 MILESIAL_WIDTHS = (64, 128, 256, 512, 1024)
@@ -145,7 +149,10 @@ class _DownS2D(nn.Module):
                 self.features, in_features=self.in_features,
                 dtype=self.dtype, wgrad_taps=self.wgrad_taps, name="conv",
             )(x, train)
-        return DoubleConv(self.features, dtype=self.dtype, name="conv")(x, train)
+        return DoubleConv(
+            self.features, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+            name="conv",
+        )(x, train)
 
 
 class _UpS2D(nn.Module):
@@ -193,15 +200,22 @@ class DoubleConv(nn.Module):
     features: int
     mid_features: int = 0  # 0 = features (bilinear Up passes in//2)
     dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         mid = self.mid_features or self.features
         for i, feats in enumerate((mid, self.features)):
-            x = nn.Conv(
-                feats, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
-                name=f"conv{i + 1}",
-            )(x)
+            if self.wgrad_taps:
+                x = _TapsPixelConv(
+                    feats, dtype=self.dtype, use_bias=False,
+                    name=f"conv{i + 1}",
+                )(x)
+            else:
+                x = nn.Conv(
+                    feats, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
+                    name=f"conv{i + 1}",
+                )(x)
             # float32 statistics; torch defaults are eps=1e-5, momentum=0.1
             # (flax momentum = 1 − torch momentum)
             x = nn.BatchNorm(
@@ -217,11 +231,15 @@ class Down(nn.Module):
 
     features: int
     dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
-        return DoubleConv(self.features, dtype=self.dtype, name="conv")(x, train)
+        return DoubleConv(
+            self.features, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+            name="conv",
+        )(x, train)
 
 
 class Up(nn.Module):
@@ -235,6 +253,7 @@ class Up(nn.Module):
     features: int
     bilinear: bool = False
     dtype: Any = jnp.bfloat16
+    wgrad_taps: bool = False
 
     @nn.compact
     def __call__(
@@ -255,7 +274,8 @@ class Up(nn.Module):
         skip = center_crop(skip, (x.shape[1], x.shape[2]))
         x = jnp.concatenate([skip, x], axis=-1)
         return DoubleConv(
-            self.features, mid_features=mid, dtype=self.dtype, name="conv"
+            self.features, mid_features=mid, dtype=self.dtype,
+            wgrad_taps=self.wgrad_taps, name="conv",
         )(x, train)
 
 
@@ -327,7 +347,9 @@ class MilesialUNet(nn.Module):
                 wgrad_taps=self.wgrad_taps, name="inc",
             )(xs, train)
         else:
-            x = DoubleConv(w[0], dtype=self.dtype, name="inc")(x, train)
+            x = DoubleConv(
+                w[0], dtype=self.dtype, wgrad_taps=self.wgrad_taps, name="inc"
+            )(x, train)
         skips = [x]
         for i, feats in enumerate(w[1:-1]):
             level = i + 1
@@ -341,7 +363,10 @@ class MilesialUNet(nn.Module):
                     name=f"down{level}",
                 )(x, train)
             else:
-                x = Down(feats, dtype=self.dtype, name=f"down{level}")(x, train)
+                x = Down(
+                    feats, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                    name=f"down{level}",
+                )(x, train)
             skips.append(x)
         last = len(w) - 1
         if last == lv and lv > 0:
@@ -351,9 +376,10 @@ class MilesialUNet(nn.Module):
                 dtype=self.dtype, name=f"down{last}",
             )(x, train)
         else:
-            x = Down(w[-1] // factor, dtype=self.dtype, name=f"down{last}")(
-                x, train
-            )
+            x = Down(
+                w[-1] // factor, dtype=self.dtype,
+                wgrad_taps=self.wgrad_taps, name=f"down{last}",
+            )(x, train)
         for i, (feats, skip) in enumerate(zip(reversed(w[:-1]), reversed(skips))):
             out_feats = feats // (factor if i < len(w) - 2 else 1)
             if i >= n_downs - lv:
@@ -371,6 +397,7 @@ class MilesialUNet(nn.Module):
                     out_feats,
                     bilinear=self.bilinear,
                     dtype=self.dtype,
+                    wgrad_taps=self.wgrad_taps,
                     name=f"up{i + 1}",
                 )(x, skip, train)
         if lv > 0:

@@ -35,6 +35,7 @@ same flax modules (`UNet.encode_mid` / `UNet.decode_head`).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Sequence, Tuple
 
 import flax.linen as nn
@@ -121,6 +122,36 @@ class _S2DConv(nn.Module):
         return y + s2d_ops.tile_bias(b).astype(y.dtype)
 
 
+class _TapsPixelConv(nn.Module):
+    """Param-compatible stand-in for ``nn.Conv(features, (3,3), padding=1)``
+    whose weight gradient runs through the 9-tap-matmul backward
+    (ops/conv_backward.py). For a 3×3 stride-1 conv, flax's ``padding=1``
+    IS 'SAME', so forward numerics are identical; only the backward
+    schedule differs."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+    use_bias: bool = True  # False matches nn.Conv(use_bias=False) (BN convs)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from distributedpytorch_tpu.ops.conv_backward import conv3x3_same_taps
+
+        w = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (3, 3, x.shape[-1], self.features),
+            jnp.float32,
+        )
+        y = conv3x3_same_taps(x.astype(self.dtype), w.astype(self.dtype))
+        if not self.use_bias:
+            return y
+        b = self.param(
+            "bias", nn.initializers.zeros_init(), (self.features,), jnp.float32
+        )
+        return y + b.astype(y.dtype)
+
+
 class ConvBlock(nn.Module):
     """[Conv3×3(pad=1) → ReLU] × 2 (reference unet_parts.py:6-17).
 
@@ -158,9 +189,16 @@ class ConvBlock(nn.Module):
             )(x)
             x = nn.relu(x)
             return x
-        x = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv1")(x)
+        conv = (
+            functools.partial(_TapsPixelConv, dtype=self.dtype)
+            if self.wgrad_taps
+            else functools.partial(
+                nn.Conv, kernel_size=(3, 3), padding=1, dtype=self.dtype
+            )
+        )
+        x = conv(self.features, name="conv1")(x)
         x = nn.relu(x)
-        x = nn.Conv(self.features, (3, 3), padding=1, dtype=self.dtype, name="conv2")(x)
+        x = conv(self.features, name="conv2")(x)
         x = nn.relu(x)
         return x
 
@@ -204,7 +242,10 @@ class Encoder(nn.Module):
                     name=f"block{i + 1}",
                 ))
             else:
-                blocks.append(ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}"))
+                blocks.append(ConvBlock(
+                    w, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                    name=f"block{i + 1}",
+                ))
             in_feats = w
         self.blocks = blocks
 
@@ -264,7 +305,10 @@ class Decoder(nn.Module):
                     w, (2, 2), strides=(2, 2), dtype=self.dtype,
                     name=f"upconv{i + 1}",
                 ))
-                blocks.append(ConvBlock(w, dtype=self.dtype, name=f"block{i + 1}"))
+                blocks.append(ConvBlock(
+                    w, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                    name=f"block{i + 1}",
+                ))
         self.ups = ups
         self.blocks = blocks
 
@@ -343,7 +387,9 @@ class UNet(nn.Module):
             in_features=self.in_channels,
             wgrad_taps=self.wgrad_taps,
         )
-        self.mid = ConvBlock(mid, dtype=self.dtype)
+        self.mid = ConvBlock(
+            mid, dtype=self.dtype, wgrad_taps=self.wgrad_taps
+        )
         self.decoder = Decoder(
             widths=tuple(reversed(self.widths)),
             dtype=self.dtype,

@@ -355,10 +355,11 @@ class TestWgradTaps:
             np.asarray(got_dk), np.asarray(ref_dk), rtol=1e-5, atol=1e-4
         )
 
-    def test_model_grads_match(self):
-        """Full UNet in s2d mode: wgrad_taps=True must land on the same
-        gradients as the default path (both through the s2d kernel
-        assembly)."""
+    @pytest.mark.parametrize("s2d", [0, 2])
+    def test_model_grads_match(self, s2d):
+        """Full UNet, both execution domains: wgrad_taps=True must land on
+        the same gradients as the default path (s2d levels through the
+        kernel assembly, pixel levels through _TapsPixelConv)."""
         from distributedpytorch_tpu.ops.losses import bce_dice_loss
 
         rng = np.random.default_rng(1)
@@ -367,7 +368,7 @@ class TestWgradTaps:
         params = None
         grads = {}
         for taps in (False, True):
-            m = UNet(dtype=jnp.float32, widths=(8, 16), s2d_levels=2,
+            m = UNet(dtype=jnp.float32, widths=(8, 16), s2d_levels=s2d,
                      wgrad_taps=taps)
             if params is None:
                 params = m.init(jax.random.key(0), img[:1])["params"]
