@@ -71,6 +71,10 @@ def get_args():
     parser.add_argument("--steps-per-dispatch", type=int, default=1,
                         help="Optimizer steps fused into one XLA dispatch "
                              "(amortizes runtime dispatch latency)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="Accumulate K batches into one optimizer step "
+                             "(effective batch K*b, one batch's activation "
+                             "memory; exact loss via stats decomposition)")
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize activations in the backward "
                              "(~half HBM, ~1/3 more FLOPs)")
@@ -160,6 +164,7 @@ def main():
         num_workers=args.num_workers,
         prefetch_batches=args.prefetch_batches,
         steps_per_dispatch=args.steps_per_dispatch,
+        grad_accum=args.grad_accum,
         remat=args.remat,
         use_pallas=args.pallas,
         model_arch=args.model_arch,

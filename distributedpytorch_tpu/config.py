@@ -134,6 +134,15 @@ class TrainConfig:
     # tunneled TPU runtimes. 1 = one dispatch per step (reference-shaped).
     steps_per_dispatch: int = 1
 
+    # -- gradient accumulation ----------------------------------------------
+    # ONE optimizer step per K loader batches (effective batch K·b) with
+    # one batch's activation memory — EXACT for the non-additive log-dice
+    # loss via the two-pass stats/cotangent scheme (train/steps.py
+    # make_accum_train_step). Stateless models only; mutually exclusive
+    # with steps_per_dispatch > 1. An epoch's trailing batches that don't
+    # fill K train as ordinary single steps.
+    grad_accum: int = 1
+
     # -- observability ------------------------------------------------------
     metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)
     profile_dir: Optional[str] = None  # jax.profiler trace capture when set

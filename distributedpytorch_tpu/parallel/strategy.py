@@ -30,6 +30,7 @@ from distributedpytorch_tpu.parallel.pipeline import (
 from distributedpytorch_tpu.train.steps import (
     TrainState,
     grouped_eval_metrics,
+    make_accum_train_step,
     make_eval_step,
     make_multi_train_step,
     make_train_step,
@@ -133,6 +134,23 @@ class Strategy:
         place the stacked batch with `place_stacked_batch`)."""
         multi = make_multi_train_step(self._raw_step(model, tx))
         return jax.jit(multi, donate_argnums=(0,))
+
+    def build_accum_train_step(self, model, tx) -> Callable:
+        """ONE optimizer step over config.grad_accum stacked batches with
+        one chunk's activation memory — exact for the non-additive
+        log-dice loss (see make_accum_train_step). The fused Pallas stats
+        run only off-mesh: inside this plain GSPMD jit a sharded chunk
+        cannot enter pallas_call (unlike the per-shard shard_map loss)."""
+        step = make_accum_train_step(
+            model,
+            tx,
+            batch_size=self.config.batch_size,
+            chunks=self.config.grad_accum,
+            faithful_loss_scaling=self.config.faithful_loss_scaling,
+            remat=self.config.remat,
+            use_pallas=self.config.use_pallas and self.mesh is None,
+        )
+        return jax.jit(step, donate_argnums=(0,))
 
     def place_stacked_batch(
         self, stacked: Dict[str, np.ndarray]
@@ -378,6 +396,12 @@ class Pipeline(Strategy):
             remat=self.config.remat,
             cuts=self.config.pipeline_cuts,
             use_pallas=self.config.use_pallas,
+        )
+
+    def build_accum_train_step(self, model, tx) -> Callable:
+        raise ValueError(
+            "pipeline strategies already microbatch inside the schedule — "
+            "raise --microbatches instead of --grad-accum"
         )
 
     def _raw_step(self, model, tx) -> Callable:

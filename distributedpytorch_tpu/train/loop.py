@@ -141,9 +141,20 @@ class Trainer:
         # K>1: fuse K optimizer steps into one dispatch (lax.scan); the
         # single-step path still handles the ragged tail of each epoch.
         self.k_dispatch = max(1, int(config.steps_per_dispatch))
+        self.grad_accum = max(1, int(config.grad_accum))
+        if self.k_dispatch > 1 and self.grad_accum > 1:
+            raise ValueError(
+                "--steps-per-dispatch and --grad-accum both stack loader "
+                "batches with conflicting step semantics — choose one"
+            )
         self.multi_step = (
             self.strategy.build_multi_train_step(self.model, self.tx)
             if self.k_dispatch > 1
+            else None
+        )
+        self.accum_step = (
+            self.strategy.build_accum_train_step(self.model, self.tx)
+            if self.grad_accum > 1
             else None
         )
         self.eval_step = self.strategy.build_eval_step(self.model)
@@ -368,13 +379,16 @@ class Trainer:
                     # only when a 10-step metrics row is due
                     self._record(loss, n_imgs, global_step, pbar)
 
-                def run_stack(buffered):
-                    nonlocal global_step
+                def stack_and_place(buffered):
                     stacked = {
                         key: np.stack([b[key] for b in buffered])
                         for key in buffered[0]
                     }
-                    placed = self.strategy.place_stacked_batch(stacked)
+                    return self.strategy.place_stacked_batch(stacked)
+
+                def run_stack(buffered):
+                    nonlocal global_step
+                    placed = stack_and_place(buffered)
                     self.state, losses = self.multi_step(self.state, placed)
                     # ONE memoized device→host pull for the whole (K,) loss
                     # array, and only when a metrics row actually needs it —
@@ -394,13 +408,34 @@ class Trainer:
                         global_step += 1
                         self._record(lazy(i), b["image"].shape[0], global_step, pbar)
 
+                def run_accum(buffered):
+                    # ONE optimizer step over the K stacked batches —
+                    # effective batch K·b, exact loss (make_accum_train_step)
+                    nonlocal global_step
+                    placed = stack_and_place(buffered)
+                    self.state, loss = self.accum_step(self.state, placed)
+                    global_step += 1
+                    self._record(
+                        loss,
+                        sum(b["image"].shape[0] for b in buffered),
+                        global_step,
+                        pbar,
+                    )
+
+                stacking = self.multi_step is not None or self.accum_step is not None
+                stack_size = (
+                    self.k_dispatch if self.multi_step is not None else self.grad_accum
+                )
+                run_buffered = (
+                    run_stack if self.multi_step is not None else run_accum
+                )
                 buffer = []
                 single_process = jax.process_count() == 1
                 source = self.train_loader.epoch_batches(epoch)
-                if self.multi_step is None and cfg.prefetch_batches > 0:
+                if not stacking and cfg.prefetch_batches > 0:
                     source = self._prefetch_placed(source, cfg.prefetch_batches)
                 else:
-                    # the fused-dispatch path places whole K-stacks itself
+                    # the stacked paths place whole K-stacks themselves
                     source = ((b, None) for b in source)
                 # closing(): breaking out mid-epoch (signal stop) must CLOSE
                 # the prefetch generator so its worker stops and queued
@@ -414,7 +449,7 @@ class Trainer:
                         # _install_signal_handler
                         if self._stop_requested and single_process:
                             break
-                        if self.multi_step is None:
+                        if not stacking:
                             run_one(batch, placed)
                             continue
                         # only full, uniformly-shaped batches can stack into
@@ -422,8 +457,8 @@ class Trainer:
                         # run_one
                         if batch["image"].shape[0] == cfg.batch_size:
                             buffer.append(batch)
-                            if len(buffer) == self.k_dispatch:
-                                run_stack(buffer)
+                            if len(buffer) == stack_size:
+                                run_buffered(buffer)
                                 buffer = []
                         else:
                             for b in buffer:

@@ -472,3 +472,104 @@ class TestGroupedEval:
             step, step, params, loader, None, ShardSpec(0, 1)
         )
         assert got == want
+
+
+class TestGradAccum:
+    """Exact gradient accumulation (make_accum_train_step): one step over
+    K stacked b-sized chunks must equal one plain step over the K·b
+    concatenated batch — the property naive per-chunk loss-grad summing
+    VIOLATES under the non-additive log-dice loss."""
+
+    def test_matches_full_batch_step(self, model, params, batch):
+        from distributedpytorch_tpu.train.steps import make_accum_train_step
+
+        K, b = 4, 2
+        stacked = {
+            k: v.reshape((K, b) + v.shape[1:]) for k, v in batch.items()
+        }
+        p = jax.tree.map(jnp.array, params)
+        state, tx = create_train_state(p, 1e-4)
+        # the equivalent single-big-batch run passes -b = K·b, so its
+        # faithful grad scale is K·b — what the accum step must match
+        plain = jax.jit(make_train_step(model, tx, batch_size=K * b))
+        ref_state, ref_loss = plain(state, batch)
+
+        p2 = jax.tree.map(jnp.array, params)
+        state2, tx2 = create_train_state(p2, 1e-4)
+        accum = jax.jit(
+            make_accum_train_step(model, tx2, batch_size=b, chunks=K)
+        )
+        got_state, got_loss = accum(state2, stacked)
+        np.testing.assert_allclose(
+            float(got_loss), float(ref_loss), rtol=1e-6, atol=1e-7
+        )
+        _tree_allclose(ref_state.params, got_state.params, rtol=5e-4, atol=3e-4)
+
+    def test_naive_accumulation_would_differ(self, model, params, batch):
+        """Sanity that the exactness above is non-trivial: the mean of
+        per-chunk losses differs from the full-batch loss (log-dice is
+        not chunk-additive), so summed per-chunk loss grads target a
+        different objective."""
+        from distributedpytorch_tpu.ops.losses import bce_dice_loss
+
+        imgs = jnp.asarray(batch["image"])
+        tgt = jnp.asarray(batch["mask"])[..., None].astype(jnp.float32)
+        preds = model.apply({"params": params}, imgs)
+        full = bce_dice_loss(preds, tgt)
+        halves = (
+            bce_dice_loss(preds[:4], tgt[:4]) + bce_dice_loss(preds[4:], tgt[4:])
+        ) / 2.0
+        assert abs(float(full) - float(halves)) > 1e-6
+
+    def test_pipeline_rejects_accum(self):
+        cfg = _config("MP", grad_accum=2)
+        strat = build_strategy(cfg)
+        m = UNet(dtype=jnp.float32, widths=WIDTHS)
+        with pytest.raises(ValueError, match="microbatch"):
+            strat.build_accum_train_step(m, None)
+
+    def test_stateful_rejects_accum(self):
+        from distributedpytorch_tpu.models.milesial import MilesialUNet
+        from distributedpytorch_tpu.train.steps import make_accum_train_step
+
+        with pytest.raises(ValueError, match="stateless"):
+            make_accum_train_step(
+                MilesialUNet(widths=(4, 8)), None, batch_size=2, chunks=2
+            )
+
+    def test_trainer_end_to_end(self, tmp_path):
+        from distributedpytorch_tpu.train import fit
+
+        cfg = TrainConfig(
+            train_method="DP",
+            epochs=1,
+            batch_size=4,
+            grad_accum=2,
+            learning_rate=1e-4,
+            compute_dtype="float32",
+            image_size=(W, H),
+            model_widths=WIDTHS,
+            synthetic_samples=20,
+            val_percent=20.0,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            log_dir=str(tmp_path / "logs"),
+            loss_dir=str(tmp_path / "loss"),
+            metric_every_steps=1,
+        )
+        result = fit(cfg)
+        # 16 train samples / (b=4) = 4 batches → 2 accum steps
+        assert result["steps"] == 2
+        assert np.isfinite(result["val_loss"])
+
+    def test_accum_excludes_steps_per_dispatch(self, tmp_path):
+        from distributedpytorch_tpu.train import Trainer
+
+        cfg = TrainConfig(
+            train_method="singleGPU", batch_size=4, grad_accum=2,
+            steps_per_dispatch=2, compute_dtype="float32",
+            image_size=(W, H), model_widths=WIDTHS, synthetic_samples=12,
+            checkpoint_dir=str(tmp_path / "c"), log_dir=str(tmp_path / "l"),
+            loss_dir=str(tmp_path / "s"),
+        )
+        with pytest.raises(ValueError, match="choose one"):
+            Trainer(cfg)

@@ -39,6 +39,8 @@ def _suspect_processes() -> list:
         ).stdout
     except Exception:
         return []
+    import re
+
     me = os.getpid()
     suspects = []
     for line in out.splitlines()[1:]:
@@ -46,10 +48,18 @@ def _suspect_processes() -> list:
         if len(parts) < 3:
             continue
         pid, etimes, args = parts
-        if "python" not in args or int(pid) in (me,):
+        if int(pid) == me:
             continue
-        if any(k in args for k in ("bench.py", "train.py", "dpt-", "jax",
-                                   "distributedpytorch", "_PROBE", "tpu_health")):
+        # the INTERPRETER must be python (first token, any version —
+        # python / python3 / python3.12), not merely a command line that
+        # mentions python somewhere (agent harnesses embed whole prompts
+        # in argv and match everything)
+        if not re.match(r"^\S*python(\d+(\.\d+)?)?(\s|$)", args):
+            continue
+        if any(k in args[:200] for k in ("bench.py", "bench_wgrad",
+                                         "bench_loader", "train.py", "dpt-",
+                                         "distributedpytorch", "tpu_health",
+                                         "import jax")):
             suspects.append({"pid": int(pid), "age_s": int(etimes),
                              "cmd": args[:160]})
     return suspects
