@@ -47,9 +47,14 @@ def save_checkpoint(
     epoch: int = 0,
     records_state: Optional[dict] = None,
     model_state=None,
+    train_meta: Optional[dict] = None,
 ) -> None:
     payload = {
         "version": CKPT_VERSION,
+        # small scalar trainer state that must survive resume (best val
+        # metrics for --save-best, early-stop patience counter) — plain
+        # msgpack-able dict, absent in older checkpoints
+        "train_meta": train_meta,
         "params": flax.serialization.to_state_dict(_to_host(params)),
         "opt_state": flax.serialization.to_state_dict(_to_host(opt_state))
         if opt_state is not None
@@ -130,6 +135,7 @@ def load_checkpoint(
         "epoch": int(payload.get("epoch", 0)),
         "records": payload.get("records"),
         "model_state": None,
+        "train_meta": payload.get("train_meta"),
     }
     if payload.get("opt_state") is not None and opt_state_target is not None:
         out["opt_state"] = flax.serialization.from_state_dict(

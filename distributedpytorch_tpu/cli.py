@@ -105,6 +105,12 @@ def get_args():
                              "checkpoint up to N times (single-process "
                              "runs; multi-process restarts belong to the "
                              "launcher)")
+    parser.add_argument("--save-best", action="store_true",
+                        help="Keep a separate <method>_best.ckpt at the "
+                             "highest validation Dice")
+    parser.add_argument("--early-stop", type=int, default=0, metavar="N",
+                        help="Stop when val loss has not improved for N "
+                             "consecutive epochs (0 = off)")
     parser.add_argument("--export-pth", action="store_true",
                         help="Also export final weights as a reference-format .pth")
     return parser.parse_args()
@@ -174,6 +180,8 @@ def main():
         checkpoint_name=resolve_checkpoint_arg(args),
         synthetic_samples=args.synthetic,
         profile_dir=args.profile_dir,
+        save_best=args.save_best,
+        early_stop_patience=args.early_stop,
     )
 
     # logfile parity: ./logs/{method}.log, append, message-only (reference

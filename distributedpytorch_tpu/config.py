@@ -108,6 +108,13 @@ class TrainConfig:
     # Mid-run checkpointing (crash recovery the reference lacks, SURVEY.md
     # §5 'Failure detection'): save every N epochs; 0 = final save only.
     checkpoint_every_epochs: int = 1
+    # Keep a separate <method>_best.ckpt at the highest val Dice seen.
+    save_best: bool = False
+    # Stop when val loss has not improved for N consecutive epochs
+    # (0 = off). Deterministic across processes: every rank sees the same
+    # val loss (sharded eval returns identical values everywhere), so all
+    # ranks stop together.
+    early_stop_patience: int = 0
 
     # -- synthetic data (tests / benches without the Carvana download) ------
     synthetic_samples: int = 0  # >0: use an in-memory procedural dataset

@@ -87,6 +87,11 @@ class Trainer:
             lr=lr0, patience=config.plateau_patience, factor=config.plateau_factor
         )
         self.start_epoch = 0
+        # scalar trainer state that must survive resume (checkpointed as
+        # train_meta): --save-best's best metrics, early-stop patience
+        self._best_dice = float("-inf")
+        self._best_loss = float("inf")
+        self._stale_epochs = 0
 
         if config.checkpoint_name:
             self._restore(config.checkpoint_name, state)
@@ -142,6 +147,11 @@ class Trainer:
         # single-step path still handles the ragged tail of each epoch.
         self.k_dispatch = max(1, int(config.steps_per_dispatch))
         self.grad_accum = max(1, int(config.grad_accum))
+        if config.early_stop_patience < 0:
+            raise ValueError(
+                f"early_stop_patience must be >= 0 (0 = off), got "
+                f"{config.early_stop_patience}"
+            )
         if self.k_dispatch > 1 and self.grad_accum > 1:
             raise ValueError(
                 "--steps-per-dispatch and --grad-accum both stack loader "
@@ -231,6 +241,10 @@ class Trainer:
                 opt_state=set_learning_rate(new_state.opt_state, self.scheduler.lr)
             )
         self.start_epoch = restored["epoch"]
+        meta = restored.get("train_meta") or {}
+        self._best_dice = float(meta.get("best_dice", float("-inf")))
+        self._best_loss = float(meta.get("best_loss", float("inf")))
+        self._stale_epochs = int(meta.get("stale_epochs", 0))
         self._restored_state = new_state
         self._restored_records = restored.get("records")
         logger.info("Resumed from %s at epoch %d", path, self.start_epoch)
@@ -248,7 +262,15 @@ class Trainer:
             epoch=epoch,
             records_state=self.records.state_dict(),
             model_state=self.state.model_state,
+            train_meta=self._train_meta(),
         )
+
+    def _train_meta(self) -> dict:
+        return {
+            "best_dice": self._best_dice,
+            "best_loss": self._best_loss,
+            "stale_epochs": self._stale_epochs,
+        }
 
     # ------------------------------------------------------------------
     def _record(self, loss, n_imgs: int, global_step: int, pbar) -> None:
@@ -355,6 +377,7 @@ class Trainer:
         global_step = int(self.state.step)
         val_loss = float("nan")
         val_dice = float("nan")
+        stopped_early = False
         for epoch in range(self.start_epoch, cfg.epochs):
             # tqdm parity (reference train_utils.py:57): per-epoch image bar,
             # main process only. Postfix shows the mean-of-last-10 row loss —
@@ -521,16 +544,64 @@ class Trainer:
                 val_dice,
                 self.records.images_per_second(),
             )
+            if (
+                cfg.save_best
+                and self.strategy.is_main
+                and val_dice > self._best_dice
+            ):
+                self._best_dice = val_dice
+                save_checkpoint(
+                    self._ckpt_path(f"{cfg.method_tag}_best"),
+                    self.state.params,
+                    self.state.opt_state,
+                    self.scheduler.state_dict(),
+                    step=int(self.state.step),
+                    epoch=epoch + 1,
+                    records_state=self.records.state_dict(),
+                    model_state=self.state.model_state,
+                    train_meta=self._train_meta(),
+                )
+                logger.info(
+                    "New best val Dice %.4f at epoch %d → %s",
+                    val_dice, epoch + 1, self._ckpt_path(f"{cfg.method_tag}_best"),
+                )
             if cfg.checkpoint_every_epochs and (
                 (epoch + 1) % cfg.checkpoint_every_epochs == 0
             ):
                 self._save(epoch + 1)
+            if cfg.early_stop_patience:
+                # NaN val loss (empty split) never counts as improvement —
+                # patience running out on no-signal epochs is deliberate
+                if val_loss < self._best_loss:
+                    self._best_loss = val_loss
+                    self._stale_epochs = 0
+                else:
+                    self._stale_epochs += 1
+                    if self._stale_epochs >= cfg.early_stop_patience:
+                        logger.info(
+                            "Early stop at epoch %d: val loss has not "
+                            "improved for %d epochs (best %.4f)",
+                            epoch + 1, self._stale_epochs, self._best_loss,
+                        )
+                        stopped_early = True
+                        self._save(epoch + 1)
+                        break
 
         if cfg.profile_dir and self.strategy.is_main:
             jax.profiler.stop_trace()
 
-        if not self._stop_requested:
+        if not self._stop_requested and not stopped_early:
             self._save(cfg.epochs)
+        if (
+            cfg.save_best
+            and self.strategy.is_main
+            and self._best_dice == float("-inf")
+        ):
+            logger.warning(
+                "--save-best: no epoch produced a finite val Dice "
+                "(empty/missing validation split?) — %s was never written",
+                self._ckpt_path(f"{cfg.method_tag}_best"),
+            )
         if self.strategy.is_main:
             self.records.save()
         return {

@@ -383,3 +383,44 @@ class TestWgradTaps:
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
             )
+
+    def test_wgrad_taps_any_shape(self):
+        """Property sweep for the 9-tap-matmul backward: for ANY shape, dx
+        and dW equal jax.grad of the plain conv."""
+        pytest.importorskip("hypothesis")  # optional test extra
+        from hypothesis import given, strategies as st
+
+        from hypothesis import HealthCheck, settings
+
+        from distributedpytorch_tpu.ops.conv_backward import conv3x3_same_taps
+        from distributedpytorch_tpu.ops.s2d import conv_same
+
+        @settings(max_examples=6, deadline=None,
+                  suppress_health_check=[HealthCheck.too_slow])
+        @given(
+            b=st.integers(1, 2),
+            h=st.integers(3, 10),
+            w=st.integers(3, 10),
+            cin=st.integers(1, 7),
+            cout=st.integers(1, 7),
+            seed=st.integers(0, 2**31 - 1),
+        )
+        def check(b, h, w, cin, cout, seed):
+            rng = np.random.default_rng(seed)
+            x = jnp.asarray(rng.standard_normal((b, h, w, cin)), jnp.float32)
+            k = jnp.asarray(rng.standard_normal((3, 3, cin, cout)), jnp.float32)
+            dy = jnp.asarray(rng.standard_normal((b, h, w, cout)), jnp.float32)
+
+            ref = jax.grad(
+                lambda x, k: jnp.sum(conv_same(x, k) * dy), argnums=(0, 1)
+            )(x, k)
+            got = jax.grad(
+                lambda x, k: jnp.sum(conv3x3_same_taps(x, k) * dy),
+                argnums=(0, 1),
+            )(x, k)
+            for g, r in zip(got, ref):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(r), atol=1e-3, rtol=1e-4
+                )
+
+        check()

@@ -291,3 +291,73 @@ def test_fit_with_restarts_surfaces_post_training_crash(tmp_path, monkeypatch):
             _config(tmp_path, epochs=2, model_widths=(8,), image_size=(16, 16)),
             max_restarts=3,
         )
+
+
+def test_save_best_checkpoint(tmp_path, monkeypatch):
+    """--save-best keeps <method>_best.ckpt at the highest val Dice —
+    driven by a controlled eval sequence (dice up, then down: the best
+    file must hold the epoch-2 state, not the final one)."""
+    import distributedpytorch_tpu.train.loop as loop_mod
+
+    dices = iter([0.3, 0.7, 0.5])
+
+    def fake_evaluate(*args, **kwargs):
+        return 1.0, next(dices)
+
+    monkeypatch.setattr(loop_mod, "evaluate", fake_evaluate)
+    cfg = _config(tmp_path, epochs=3, save_best=True)
+    trainer = Trainer(cfg)
+    trainer.train()
+    best = tmp_path / "checkpoints" / "singleGPU_best.ckpt"
+    assert best.exists()
+    from distributedpytorch_tpu.checkpoint import load_checkpoint
+
+    restored = load_checkpoint(
+        str(best), trainer.state.params, trainer.state.opt_state
+    )
+    assert restored["epoch"] == 2  # the 0.7-dice epoch
+
+
+def test_early_stopping(tmp_path, monkeypatch):
+    """--early-stop N breaks the epoch loop after N non-improving epochs
+    of a controlled val-loss sequence."""
+    import distributedpytorch_tpu.train.loop as loop_mod
+
+    losses = iter([1.0, 0.5, 0.6, 0.7, 0.4, 0.4])
+
+    def fake_evaluate(*args, **kwargs):
+        return next(losses), 0.5
+
+    monkeypatch.setattr(loop_mod, "evaluate", fake_evaluate)
+    cfg = _config(tmp_path, epochs=6, early_stop_patience=2)
+    result = Trainer(cfg).train()
+    # improves at e1,e2; stale e3,e4 → stop after epoch 4 of 6
+    n_batches = 24 // 8  # train samples / batch
+    assert result["steps"] == 4 * n_batches
+    assert (tmp_path / "checkpoints" / "singleGPU.ckpt").exists()
+
+
+def test_save_best_survives_resume(tmp_path, monkeypatch):
+    """train_meta (best dice, early-stop patience) is checkpointed: a
+    resumed run must not overwrite <method>_best.ckpt with a worse model."""
+    import distributedpytorch_tpu.train.loop as loop_mod
+
+    def eval_seq(values):
+        it = iter(values)
+        return lambda *a, **k: (1.0, next(it))
+
+    monkeypatch.setattr(loop_mod, "evaluate", eval_seq([0.3, 0.8]))
+    cfg = _config(tmp_path, epochs=2, save_best=True)
+    Trainer(cfg).train()
+    best = tmp_path / "checkpoints" / "singleGPU_best.ckpt"
+    mtime = best.stat().st_mtime_ns
+
+    # resume for 2 more epochs with WORSE dice: best must stay untouched
+    monkeypatch.setattr(loop_mod, "evaluate", eval_seq([0.5, 0.6]))
+    cfg2 = _config(
+        tmp_path, epochs=4, save_best=True, checkpoint_name="singleGPU"
+    )
+    trainer = Trainer(cfg2)
+    assert trainer._best_dice == pytest.approx(0.8)
+    trainer.train()
+    assert best.stat().st_mtime_ns == mtime
