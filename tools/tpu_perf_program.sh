@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# The round-4 on-chip measurement program (docs/ROUND4.md items 1-2), run
+# the moment the TPU runtime answers. Sequential — ONE TPU process at a
+# time (a second client wedges the tunneled runtime) — with generous
+# timeouts (first compiles are minutes over the tunnel) and SIGTERM-only
+# semantics throughout (bench.py/tpu_health.py already obey this).
+#
+#   bash tools/tpu_perf_program.sh [outdir]
+#
+# Writes <outdir>/{health_pre,bench_default,bench_taps,wgrad_ab,health_post}
+# artifacts; aborts before the expensive steps if the pre-flight fails.
+set -u
+OUT="${1:-.perf_r04}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== pre-flight health probe"
+if ! python tools/tpu_health.py --timeout 300 --out "$OUT/health_pre.json"; then
+    echo "runtime unhealthy — aborting (see $OUT/health_pre.json)"
+    exit 1
+fi
+
+echo "== bench: shipping config"
+BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_default.json"
+
+echo "== bench: --wgrad-taps A/B"
+BENCH_WGRAD_TAPS=1 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_taps.json"
+
+echo "== per-shape + full-step wgrad A/B"
+timeout --signal=TERM 1800 \
+    python -u tools/bench_wgrad.py --steps 10 --full-step \
+    | tee "$OUT/wgrad_ab.jsonl"
+
+echo "== post-run health probe (chip hygiene artifact)"
+python tools/tpu_health.py --timeout 300 --out "$OUT/health_post.json"
+cp "$OUT/health_post.json" TPU_HEALTH.json
+echo "done — artifacts in $OUT/, TPU_HEALTH.json updated"
