@@ -521,6 +521,27 @@ class TestGradAccum:
         ) / 2.0
         assert abs(float(full) - float(halves)) > 1e-6
 
+    def test_accum_composes_with_pallas(self, model, params, batch):
+        """--grad-accum + --pallas: per-chunk fused stats (custom_vjp under
+        lax.scan) must land where the XLA stats do."""
+        from distributedpytorch_tpu.train.steps import make_accum_train_step
+
+        K, b = 4, 2
+        stacked = {
+            k: v.reshape((K, b) + v.shape[1:]) for k, v in batch.items()
+        }
+        outs = {}
+        for pallas in (False, True):
+            p = jax.tree.map(jnp.array, params)
+            state, tx = create_train_state(p, 1e-4)
+            step = jax.jit(make_accum_train_step(
+                model, tx, batch_size=b, chunks=K, use_pallas=pallas
+            ))
+            s2, loss = step(state, stacked)
+            outs[pallas] = (float(loss), jax.device_get(s2.params))
+        np.testing.assert_allclose(outs[True][0], outs[False][0], rtol=2e-5)
+        _tree_allclose(outs[False][1], outs[True][1], rtol=5e-4, atol=3e-4)
+
     def test_pipeline_rejects_accum(self):
         cfg = _config("MP", grad_accum=2)
         strat = build_strategy(cfg)
