@@ -43,6 +43,10 @@ import time
 BASELINE_IMGS_PER_SEC = 28.0
 BASELINE_SOURCE = "estimate"
 
+# Wall-clock origin for the compile-budget check in run() — module import
+# happens within the first second of the process either way.
+_START = time.monotonic()
+
 BATCH = int(os.environ.get("BENCH_BATCH", 4))
 H = int(os.environ.get("BENCH_H", 640))
 W = int(os.environ.get("BENCH_W", 960))
@@ -241,11 +245,25 @@ def run() -> dict:
     compiled = (
         jax.jit(step_fn, donate_argnums=(0,)).lower(state, batch).compile()
     )
-    multi = (
-        jax.jit(make_multi_train_step(step_fn), donate_argnums=(0,))
-        .lower(state, stacked)
-        .compile()
-    )
+    # The fused K-step executable is the bigger compile; on a slow-but-
+    # alive runtime, skip it rather than let the watchdog kill the run
+    # with NO number — the single-dispatch figure is a valid (lower-bound)
+    # headline (VERDICT r03: three rounds of empty artifacts).
+    budget = float(os.environ.get("BENCH_WATCHDOG_SECS", 900))
+    if time.monotonic() - _START < 0.5 * budget:
+        multi = (
+            jax.jit(make_multi_train_step(step_fn), donate_argnums=(0,))
+            .lower(state, stacked)
+            .compile()
+        )
+    else:
+        print(
+            "bench: skipping the fused-dispatch executable "
+            f"({time.monotonic() - _START:.0f}s elapsed of {budget:.0f}s "
+            "budget) — headline falls back to single-dispatch",
+            file=sys.stderr,
+        )
+        multi = None
     # Executed FLOPs (XLA cost analysis of the compiled step). With the
     # default space-to-depth execution mode this EXCEEDS the model's logical
     # FLOPs — the structured dense kernels multiply by zeros the MXU schedule
@@ -280,15 +298,18 @@ def run() -> dict:
     # measured window is ≥3 dispatches / ≥30 steps vs the unfused 20 — so
     # min() below compares like with like instead of letting one lucky
     # 2-dispatch window pick the headline
-    state, losses = multi(state, stacked)
-    float(losses[-1])
-    reps = max(3, MEASURE_STEPS // FUSED_STEPS)
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    if multi is not None:
         state, losses = multi(state, stacked)
-    float(losses[-1])
-    dt_fused = time.perf_counter() - t0
-    fused_per_step = dt_fused / (reps * FUSED_STEPS)
+        float(losses[-1])
+        reps = max(3, MEASURE_STEPS // FUSED_STEPS)
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, losses = multi(state, stacked)
+        float(losses[-1])
+        dt_fused = time.perf_counter() - t0
+        fused_per_step = dt_fused / (reps * FUSED_STEPS)
+    else:
+        fused_per_step = float("inf")
 
     per_step = min(fused_per_step, unfused_per_step)
     imgs_per_sec = BATCH / per_step
