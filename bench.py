@@ -19,11 +19,32 @@ Honest accounting (VERDICT.md round 2 item 3):
 ``vs_baseline``: the reference publishes no throughput numbers (SURVEY.md
 §6); BASELINE.md's operational target is its 2×GPU DDP config. Until a
 measured GPU number exists we normalize against an estimated 2×RTX-3090-class
-fp32 DDP throughput for this exact model/shape: ~0.77 TFLOP/img per train
-step at ~10-12 effective TFLOP/s per GPU (fp32 convs, no AMP in the
-reference) ≈ 14 imgs/s/GPU ≈ 28 imgs/s for the pair — explicit and
-revisable, recorded here so the denominator is never fabricated, and
-carried in-band as ``baseline_source: "estimate"``.
+DDP throughput for this exact model/shape, carried as a BOUNDED RANGE
+(VERDICT r04 next-4), derivation:
+
+  * Work: ~0.77 TFLOP logical per image per train step (same analytic conv
+    sum as the TPU side, ANALYTIC_STEP_FLOPS_PER_IMG).
+  * Peak: RTX 3090 / GA102 = 35.6 TFLOP/s fp32 FFMA; the TF32 tensor-core
+    dense rate on GeForce Ampere is the same 35.6 TFLOP/s (NVIDIA
+    "GA102 whitepaper", shading/tensor performance tables). The reference
+    trains fp32 with no AMP (reference train.py has no autocast), but
+    PyTorch runs cuDNN convs in TF32 by default on Ampere
+    (torch.backends.cudnn.allow_tf32=True — PyTorch docs, "CUDA semantics:
+    TensorFloat-32"), so both paths share the same peak and differ in
+    achievable utilization.
+  * Utilization bracket for large-image UNet convs: ~20% of peak on the
+    fp32 FFMA path (consistent with classic public fp32 ResNet-50 numbers,
+    e.g. ~360 imgs/s on V100 ≈ 18% of its 15.7 TFLOP/s peak) up to ~55%
+    for well-tiled TF32 tensor-core convs (cuDNN benchmark-mode heuristics,
+    reference train_utils sets torch.backends.cudnn.benchmark).
+  * Per GPU: 0.20·35.6/0.77 ≈ 9 imgs/s … 0.55·35.6/0.77 ≈ 25 imgs/s;
+    ×2 GPUs at 0.90-0.97 DDP scaling → PAIR RANGE ≈ 17-49 imgs/s.
+    Central point stays 28 (the round-1..4 estimate, mid-range).
+
+Explicit and revisable, recorded here so the denominator is never
+fabricated; carried in-band as ``baseline_source: "estimate"`` with
+``baseline_range`` and worst/best-case ``vs_baseline_vs_high`` /
+``vs_baseline_vs_low`` alongside the central ``vs_baseline``.
 
 Exit codes: 0 = measured number; 2 = preflight never reached a live
 runtime (JSON carries the staged probe history); 3 = watchdog fired
@@ -36,12 +57,28 @@ import subprocess
 import sys
 import time
 
-# Estimated reference DDP (2 GPU, fp32) throughput for batch 4 @ 3x640x960 —
+# Estimated reference DDP (2 GPU) throughput for batch 4 @ 3x640x960 —
 # derivation in the module docstring; revise when a measured number lands.
 # ``baseline_source: "estimate"`` rides in the JSON so consumers see the
-# caveat in-band, not only here (VERDICT r03 weak-9).
+# caveat in-band, not only here (VERDICT r03 weak-9). The range bounds the
+# utilization bracket (fp32-FFMA floor … TF32-tensor-core ceiling);
+# the central point is the original mid-range estimate (VERDICT r04 next-4).
 BASELINE_IMGS_PER_SEC = 28.0
+BASELINE_RANGE = (17.0, 49.0)
 BASELINE_SOURCE = "estimate"
+
+
+def _baseline_fields(imgs_per_sec: float) -> dict:
+    """The denominator block every bench JSON carries in-band: central
+    normalization plus worst/best-case against the bounded range."""
+    return {
+        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
+        "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
+        "baseline_range_imgs_per_sec": list(BASELINE_RANGE),
+        "vs_baseline_vs_high": round(imgs_per_sec / BASELINE_RANGE[1], 3),
+        "vs_baseline_vs_low": round(imgs_per_sec / BASELINE_RANGE[0], 3),
+        "baseline_source": BASELINE_SOURCE,
+    }
 
 # Wall-clock origin for the compile-budget check in run() — module import
 # happens within the first second of the process either way.
@@ -318,9 +355,7 @@ def run() -> dict:
         "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
-        "vs_baseline": round(imgs_per_sec / BASELINE_IMGS_PER_SEC, 3),
-        "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
-        "baseline_source": BASELINE_SOURCE,
+        **_baseline_fields(imgs_per_sec),
         "step_time_ms": round(1e3 * per_step, 2),
         "steps_per_dispatch": FUSED_STEPS if per_step == fused_per_step else 1,
         "imgs_per_sec_single_dispatch": round(BATCH / unfused_per_step, 2),
@@ -357,9 +392,7 @@ def _arm_watchdog(seconds: float) -> None:
             "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_timeout",
             "value": 0.0,
             "unit": "imgs/sec",
-            "vs_baseline": 0.0,
-            "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
-            "baseline_source": BASELINE_SOURCE,
+            **_baseline_fields(0.0),
             "error": f"watchdog: no result after {seconds:.0f}s "
                      "(TPU runtime unreachable or wedged)",
         }))
@@ -394,9 +427,7 @@ def main():
                 "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_preflight",
                 "value": 0.0,
                 "unit": "imgs/sec",
-                "vs_baseline": 0.0,
-                "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
-                "baseline_source": BASELINE_SOURCE,
+                **_baseline_fields(0.0),
                 "error": "preflight: runtime never answered a trivial "
                          f"probe in {len(history)} staged attempts over "
                          f"{time.monotonic() - t0:.0f}s",
@@ -438,9 +469,7 @@ def main():
             "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_error",
             "value": 0.0,
             "unit": "imgs/sec",
-            "vs_baseline": 0.0,
-            "baseline_imgs_per_sec": BASELINE_IMGS_PER_SEC,
-            "baseline_source": BASELINE_SOURCE,
+            **_baseline_fields(0.0),
             "error": f"{type(exc).__name__}: {exc}",
         }
     print(json.dumps(result))

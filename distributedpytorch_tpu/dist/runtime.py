@@ -51,6 +51,27 @@ def _torchrun_env() -> Optional[RuntimeInfo]:
     return RuntimeInfo(rank, world, f"{addr}:{port}")
 
 
+def _warm_host_collectives() -> None:
+    """Form the all-process host-collective (Gloo, on CPU backends) context
+    NOW, while every rank is still in lockstep from `initialize()`'s
+    rendezvous.
+
+    Gloo context formation has a hard ~30 s key-value deadline per peer.
+    Without this warm-up the first host collective is wherever the trainer
+    first calls `multihost_utils.process_allgather` — the per-epoch stop
+    check (train/loop.py `_stop_agreed`) — by which point rank skew on a
+    contended host (N processes time-slicing few cores, compile times
+    diverging) can exceed the deadline and kill the whole job with
+    "Gloo context initialization failed: DEADLINE_EXCEEDED" (observed with
+    4 localhost processes on a 1-core box). Once the context exists,
+    later collectives block on connected sockets with no such deadline.
+    On TPU pods this is a single sub-millisecond allgather — harmless."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    multihost_utils.process_allgather(np.zeros((1,), np.int32))
+
+
 def initialize_from_env(force: bool = False) -> RuntimeInfo:
     """Initialize multi-process JAX if a launcher env is present.
 
@@ -68,6 +89,8 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
         jax.distributed.initialize()
         _INITIALIZED = True
         info = RuntimeInfo(jax.process_index(), jax.process_count(), None)
+        if info.num_processes > 1:
+            _warm_host_collectives()
         logger.info(
             "jax.distributed auto-initialized: process %d/%d",
             info.process_id,
@@ -94,6 +117,7 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
         process_id=info.process_id,
     )
     _INITIALIZED = True
+    _warm_host_collectives()
     logger.info(
         "jax.distributed initialized: process %d/%d via %s",
         info.process_id,

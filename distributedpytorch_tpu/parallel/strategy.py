@@ -497,6 +497,54 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
     def drop_last_train(self) -> bool:
         return True
 
+    def eval_shard(self) -> ShardSpec:
+        """The grouped eval stack is sharded over 'data' but REPLICATED
+        over 'stage', so every process whose devices sit in the same data
+        row must feed the SAME val batch — `make_array_from_process_local_data`
+        does not cross-check replicas, and co-row processes feeding
+        different batches silently corrupts the stack (found by
+        test_four_process: 4 procs × 1 device on a {data:2, stage:2}
+        mesh produced ~2%-wrong val metrics).
+
+        Three regimes:
+          * every data row's devices belong to ONE process (e.g. 2 procs
+            × 2 local devices): the mixin's process round-robin is safe
+            and maximally parallel;
+          * some row spans processes but each process sits in exactly one
+            row: round-robin over DATA ROWS (world = data degree, rank =
+            this process's row) — co-row processes load identical
+            batches, redundant but consistent;
+          * anything else (some process spans rows while rows are also
+            shared): fall back to replicated evaluation rather than
+            corrupt.
+
+        Every branch is decided from the GLOBAL process→row map (the mesh
+        is identical on all processes), never from this process's own
+        placement alone — processes disagreeing on the regime would issue
+        different collective programs and deadlock the job at the first
+        eval."""
+        if jax.process_count() == 1:
+            return ShardSpec(0, 1)
+        row_procs = [
+            {d.process_index for d in row.flat} for row in self.mesh.devices
+        ]
+        proc_rows = {}
+        for i, procs in enumerate(row_procs):
+            for p in procs:
+                proc_rows.setdefault(p, set()).add(i)
+        # A shrunk mesh can orphan whole processes (dp capped by the batch
+        # leaves devs unused): round-robin over EITHER processes or rows
+        # would hand orphans batches no mesh shard consumes. Replicated
+        # fallback — and globally, so every process picks the same regime.
+        if set(proc_rows) != set(range(jax.process_count())):
+            return ShardSpec(0, 1)
+        if all(len(s) == 1 for s in row_procs):
+            return ShardSpec(jax.process_index(), jax.process_count())
+        if any(len(rows) != 1 for rows in proc_rows.values()):
+            return ShardSpec(0, 1)  # ALL processes take this branch
+        my_row = next(iter(proc_rows[jax.process_index()]))
+        return ShardSpec(my_row, len(row_procs))
+
     def _loss_fn(self, model):
         return make_pipeline_loss_fn(
             model,

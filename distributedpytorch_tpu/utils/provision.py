@@ -1,0 +1,64 @@
+"""Virtual-device provisioning env for CPU-mesh subprocesses.
+
+jax backends initialize once per process, and the remote-TPU PJRT plugin in
+this image dials out from sitecustomize at interpreter start — so a
+process that wants an n-device virtual CPU mesh must have the right env
+BEFORE its interpreter starts. Every self-provisioning entry point
+(`__graft_entry__.dryrun_multichip`, `tools/bench_pipeline.py`, the test
+conftest) needs the same three moves: pin JAX_PLATFORMS=cpu, rewrite
+--xla_force_host_platform_device_count in XLA_FLAGS, and blank the relay's
+pool var so nothing dials the TPU. ONE definition here so a future
+addition (say, a new env var that must be cleared) lands everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Mapping, MutableMapping, Optional
+
+
+def provisioned_env(
+    n_devices: int, base: Mapping[str, str] | None = None
+) -> MutableMapping[str, str]:
+    """A copy of ``base`` (default ``os.environ``) prepared for a subprocess
+    that must see ``n_devices`` virtual CPU devices and never touch the
+    tunneled TPU runtime."""
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    flags = " ".join(
+        f for f in flags.split()
+        if "xla_force_host_platform_device_count" not in f
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n_devices)}"
+    ).strip()
+    env["PALLAS_AXON_POOL_IPS"] = ""  # never dial the TPU relay
+    return env
+
+
+def maybe_reexec_provisioned(
+    n_devices: int,
+    sentinel: str,
+    extra_env: Optional[Mapping[str, str]] = None,
+) -> Optional[int]:
+    """The self-provisioning entry-point dance, in one place: if
+    ``sentinel`` is already set this process IS the provisioned child —
+    return None and let the caller proceed. Otherwise re-run
+    ``sys.argv`` under ``provisioned_env(n_devices)`` (plus ``extra_env``
+    as setdefaults) and return the child's exit code for the caller to
+    propagate. Used by tools/bench_pipeline.py and
+    tools/convergence_run.py; __graft_entry__ keeps its own variant (it
+    re-execs a ``-c`` command, not a script file)."""
+    if os.environ.get(sentinel) == "1":
+        return None
+    env = provisioned_env(n_devices)
+    for key, value in (extra_env or {}).items():
+        env.setdefault(key, value)
+    env[sentinel] = "1"
+    return subprocess.run(
+        [sys.executable, "-u", os.path.abspath(sys.argv[0])] + sys.argv[1:],
+        env=env,
+    ).returncode

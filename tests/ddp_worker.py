@@ -47,7 +47,13 @@ def main():
         compute_dtype="float32",
         image_size=(48, 32),
         model_widths=(8, 16),  # tiny model: this tests the runtime, not UNet
-        synthetic_samples=32,
+        # 64 samples → 16 val → 4 val batches: at world=4 that is exactly
+        # one sharded-eval group (n_groups = 4//4 = 1), so the grouped
+        # dispatch ACTUALLY EXECUTES in the 4-process test (with 32
+        # samples it had 2 batches → n_groups 0 and everything fell to
+        # the replicated tail, making sharded==replicated trivially true);
+        # at world=2 it is 2 groups, strictly more coverage than before.
+        synthetic_samples=64,
         checkpoint_dir=os.path.join(out_dir, "checkpoints"),
         log_dir=os.path.join(out_dir, "logs"),
         loss_dir=os.path.join(out_dir, "loss"),

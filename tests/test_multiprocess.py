@@ -1,7 +1,16 @@
-"""Multi-process DDP integration test: 2 OS processes × 2 virtual CPU devices
-each, rendezvous over localhost with torchrun-style env — the real
+"""Multi-process DDP integration tests: N OS processes × L virtual CPU
+devices each, rendezvous over localhost with torchrun-style env — the real
 `jax.distributed` path the single-process mesh tests cannot cover
-(SURVEY.md §4: 'multi-process tests via jax.distributed over localhost')."""
+(SURVEY.md §4: 'multi-process tests via jax.distributed over localhost').
+
+Two topology families (VERDICT r04 next-6):
+  * 2 procs × 2 devices — the round-3/4 configuration;
+  * 4 procs × 1 device — process-count (4) differs from BOTH mesh axis
+    sizes in the DDP_MP hybrid ({data:2, stage:2}), and the sharded
+    evaluator's grouped dispatch runs at a world size it had never
+    executed at (4 val batches = exactly one 4-rank group) — the
+    first-pod-run code paths.
+"""
 
 import getpass
 import json
@@ -12,7 +21,8 @@ import sys
 
 import pytest
 
-WORLD = 2
+from distributedpytorch_tpu.utils.provision import provisioned_env
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "ddp_worker.py")
 
@@ -23,29 +33,21 @@ def _free_port():
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-@pytest.mark.parametrize("method,mesh_data", [("DDP", 4), ("DDP_MP", 2)])
-def test_two_process(tmp_path, method, mesh_data):
-    """DDP: 4-device global data mesh. DDP_MP: {data:2, stage:2} — the one
-    multi-process path that crosses jax.distributed with the explicit
-    pipeline schedule (VERDICT r03 next-8). Both also assert the sharded
-    evaluator against the replicated path on every rank."""
+def _launch_world(tmp_path, world, local_devices, method):
     port = _free_port()
     procs = []
-    for rank in range(WORLD):
-        env = dict(os.environ)
+    for rank in range(world):
+        # CPU backend with `local_devices` virtual devices, relay disabled
+        # (ONE definition of those moves: utils/provision.py)
+        env = provisioned_env(local_devices)
         env.update(
             {
                 # torchrun contract (reference README.md:37)
                 "RANK": str(rank),
                 "LOCAL_RANK": str(rank),
-                "WORLD_SIZE": str(WORLD),
+                "WORLD_SIZE": str(world),
                 "MASTER_ADDR": "127.0.0.1",
                 "MASTER_PORT": str(port),
-                # CPU backend, 2 virtual devices per process
-                "JAX_PLATFORMS": "cpu",
-                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
-                "PALLAS_AXON_POOL_IPS": "",
                 # per-rank but PERSISTENT compilation cache: splitting by
                 # rank avoids two ranks racing on identical entries, while
                 # keeping warm-cache speed across runs (tmp_path would be
@@ -67,29 +69,76 @@ def test_two_process(tmp_path, method, mesh_data):
             )
         )
 
-    outputs = [p.communicate(timeout=900)[0] for p in procs]
+    # 1-core boxes serialize all ranks' compiles: world=4 with cold
+    # per-rank caches needs well over the old 900 s budget. On timeout,
+    # kill the SURVIVING ranks too — otherwise a single wedged rank
+    # leaves world−1 live workers holding MASTER_PORT and the CPU while
+    # the next parametrized case tries to run.
+    outputs = []
+    try:
+        for p in procs:
+            outputs.append(p.communicate(timeout=1800)[0])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for rank, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
 
     reports = []
-    for rank in range(WORLD):
+    for rank in range(world):
         with open(tmp_path / f"rank{rank}.json") as f:
             reports.append(json.load(f))
+    return reports
 
-    # expected global mesh (2 procs × 2 local devices)
+
+def _assert_world(tmp_path, reports, method, mesh_data):
+    r0 = reports[0]
+    # expected global data-axis extent (world × local devices / stage axis)
     assert all(r["mesh_data"] == mesh_data for r in reports)
-    # replicas identical after gradient all-reduce
-    assert reports[0]["fingerprint"] == pytest.approx(
-        reports[1]["fingerprint"], rel=1e-6
-    )
-    assert reports[0]["steps"] == reports[1]["steps"] > 0
+    assert r0["steps"] > 0
+    for r in reports[1:]:
+        # replicas identical after gradient all-reduce
+        assert r["fingerprint"] == pytest.approx(r0["fingerprint"], rel=1e-6)
+        assert r["steps"] == r0["steps"]
     # sharded eval == replicated eval, on every rank, and identical values
-    # across ranks (each rank loaded only its own share)
+    # across ranks (each rank loads only its own round-robin share; the
+    # grouped dispatch's replicated out_shardings hands every rank the
+    # full-group metrics). abs=1e-8: the replicated path evaluates each
+    # batch process-DUPLICATED (make_array_from_process_local_data concats
+    # every rank's identical copy), which loss and dice are invariant to
+    # EXCEPT for the eps regularizer — a fully-collapsed model's dice
+    # (~1e-10, pure eps floor) legitimately differs by the duplication
+    # factor, while any real dice (≥1e-4) still gets the tight rel bound.
     for r in reports:
-        assert r["sharded_val"] == pytest.approx(r["replicated_val"], rel=1e-5)
-    assert reports[0]["sharded_val"] == pytest.approx(
-        reports[1]["sharded_val"], rel=1e-6
-    )
+        assert r["sharded_val"] == pytest.approx(
+            r["replicated_val"], rel=1e-5, abs=1e-8)
+        assert r["sharded_val"] == pytest.approx(
+            r0["sharded_val"], rel=1e-6, abs=1e-9)
     # rank-0-only artifacts (reference train_utils.py:243-248 gating)
     assert os.path.exists(tmp_path / "checkpoints" / f"{method}.ckpt")
     assert os.path.exists(tmp_path / "loss" / method / "train_loss.pkl")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("method,mesh_data", [("DDP", 4), ("DDP_MP", 2)])
+def test_two_process(tmp_path, method, mesh_data):
+    """2 procs × 2 devices. DDP: 4-device global data mesh. DDP_MP:
+    {data:2, stage:2} — crosses jax.distributed with the explicit pipeline
+    schedule (VERDICT r03 next-8)."""
+    reports = _launch_world(tmp_path, world=2, local_devices=2, method=method)
+    _assert_world(tmp_path, reports, method, mesh_data)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("method,mesh_data", [("DDP", 4), ("DDP_MP", 2)])
+def test_four_process(tmp_path, method, mesh_data):
+    """4 procs × 1 device (VERDICT r04 next-6). For DDP_MP the process
+    count (4) equals NEITHER mesh axis ({data:2, stage:2}), so the
+    stage edge's ppermute and the gradient all-reduce both cross process
+    boundaries; for both methods the sharded evaluator's grouped
+    dispatch executes at world 4 (one exact 4-rank group, each rank
+    loading only its own batch)."""
+    reports = _launch_world(tmp_path, world=4, local_devices=1, method=method)
+    _assert_world(tmp_path, reports, method, mesh_data)

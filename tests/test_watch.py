@@ -1,0 +1,140 @@
+"""tools/tpu_watch.py: ledger append semantics and fire-once behavior,
+with the probe and the perf program mocked (no TPU, no subprocesses)."""
+
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import tpu_watch
+
+
+def _read(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_ledger_appends_timestamped_records(tmp_path):
+    ledger = tmp_path / "poll.jsonl"
+    tpu_watch.append_ledger(str(ledger), {"event": "probe", "ok": False})
+    tpu_watch.append_ledger(str(ledger), {"event": "probe", "ok": True})
+    records = _read(ledger)
+    assert [r["event"] for r in records] == ["probe", "probe"]
+    assert all(r["ts"].endswith("Z") for r in records)
+
+
+def test_watcher_fires_program_once(tmp_path, monkeypatch):
+    """Dead → dead → alive → alive: the perf program fires exactly once, on
+    the first healthy probe, and the ledger records every poll plus the
+    program start/done events."""
+    ledger = tmp_path / "poll.jsonl"
+    outdir = tmp_path / "perf"
+    def results_gen():
+        yield {"ok": False, "error": "probe timeout after 1s"}
+        yield {"ok": False, "error": "probe timeout after 1s"}
+        while True:
+            yield {"ok": True, "platform": "tpu", "device_kind": "v5e",
+                   "secs": 2.0}
+
+    results = results_gen()
+    fired = []
+    monkeypatch.setattr(tpu_watch, "_probe_once", lambda t: next(results))
+    monkeypatch.setattr(
+        tpu_watch, "fire_perf_program",
+        lambda out, log: fired.append(out) or 0)
+    monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+
+    # 4 polls inside the deadline, then stop
+    clock = itertools.count()
+    monkeypatch.setattr(
+        tpu_watch.time, "monotonic", lambda: float(next(clock)))
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_watch.py", "--ledger", str(ledger), "--interval", "1",
+         "--post-interval", "1", "--probe-timeout", "1",
+         "--max-hours", str(20 / 3600.0), "--perf-out", str(outdir)])
+    assert tpu_watch.main() == 0
+
+    assert fired == [str(outdir)]  # fired exactly once
+    assert os.path.exists(outdir / "FIRED")
+    events = [r["event"] for r in _read(ledger)]
+    assert events[0] == "watcher_start"
+    assert events[-1] == "watcher_stop"
+    assert events.count("perf_program_start") == 1
+    assert events.count("perf_program_done") == 1
+    probes = [r for r in _read(ledger) if r["event"] == "probe"]
+    assert [p["ok"] for p in probes[:3]] == [False, False, True]
+
+
+def test_watcher_holds_off_while_orphan_probe_alive(tmp_path, monkeypatch):
+    """A probe that ignored SIGTERM is still attached to the runtime; the
+    watcher must NOT launch a second concurrent client until that pid
+    exits (two clients wedge the tunneled runtime)."""
+    ledger = tmp_path / "poll.jsonl"
+    probes = []
+
+    def fake_probe(timeout):
+        probes.append(1)
+        return {"ok": False,
+                "error": "probe hung 1s, ignored SIGTERM "
+                         "(left running, pid 12345)"}
+
+    alive = {"12345": True}
+    monkeypatch.setattr(tpu_watch, "_probe_once", fake_probe)
+    monkeypatch.setattr(
+        tpu_watch, "_pid_alive", lambda pid: alive[str(pid)])
+    monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+    clock = itertools.count()
+    monkeypatch.setattr(
+        tpu_watch.time, "monotonic", lambda: float(next(clock)))
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_watch.py", "--ledger", str(ledger), "--interval", "1",
+         "--probe-timeout", "1", "--max-hours", str(30 / 3600.0),
+         "--perf-out", str(tmp_path / "perf")])
+    assert tpu_watch.main() == 0
+    # exactly ONE probe launched; every later cycle waited on the orphan
+    assert len(probes) == 1
+    events = [r["event"] for r in _read(ledger)]
+    assert "waiting_orphan_probe" in events
+
+
+def test_failed_fired_marker_does_not_disable(tmp_path):
+    """A FIRED marker from the bounded give-up (rc!=0) must NOT read as
+    already-fired — a restarted watcher should retry measurement."""
+    marker = tmp_path / "FIRED"
+    marker.write_text("2026-07-30T00:00:00Z rc=1 attempts=3\n")
+    assert not tpu_watch._fired_successfully(str(marker))
+    marker.write_text("2026-07-30T00:00:00Z rc=0 attempts=2\n")
+    assert tpu_watch._fired_successfully(str(marker))
+    assert not tpu_watch._fired_successfully(str(tmp_path / "missing"))
+
+
+def test_watcher_respects_existing_fired_marker(tmp_path, monkeypatch):
+    """A restarted watcher must not re-fire the program if a previous
+    instance already SUCCEEDED (FIRED marker with rc=0)."""
+    ledger = tmp_path / "poll.jsonl"
+    outdir = tmp_path / "perf"
+    os.makedirs(outdir)
+    (outdir / "FIRED").write_text("2026-07-30T00:00:00Z rc=0 attempts=1\n")
+    monkeypatch.setattr(
+        tpu_watch, "_probe_once",
+        lambda t: {"ok": True, "platform": "tpu", "secs": 1.0})
+    monkeypatch.setattr(
+        tpu_watch, "fire_perf_program",
+        lambda out, log: (_ for _ in ()).throw(AssertionError("re-fired")))
+    monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+    clock = itertools.count()
+    monkeypatch.setattr(
+        tpu_watch.time, "monotonic", lambda: float(next(clock)))
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_watch.py", "--ledger", str(ledger), "--interval", "1",
+         "--post-interval", "1", "--probe-timeout", "1",
+         "--max-hours", str(5 / 3600.0), "--perf-out", str(outdir)])
+    assert tpu_watch.main() == 0
+    events = [r["event"] for r in _read(ledger)]
+    assert "perf_program_start" not in events
