@@ -21,14 +21,34 @@ backward; exactness vs `jax.grad` of the plain conv is pinned in
 tests/test_s2d.py. Off by default (`TrainConfig.wgrad_taps`) until the
 TPU measurement lands — this is a hypothesis with a test harness, not a
 claimed win.
+
+Backend: the tap contraction itself has two implementations — the 9
+einsums below, and a single-pass Pallas kernel (ops/wgrad_pallas.py)
+that loads each row once and accumulates all nine taps from VMEM.
+``DPT_WGRAD_BACKEND=pallas`` selects the kernel AT TRACE TIME (set it
+before the first jit of the model; already-compiled executables keep
+whatever they traced). The Pallas path engages only for channel counts
+that fill the 128-wide MXU/lane tiles; skinny convs (the RGB stem) stay
+on einsum.
 """
 
 from __future__ import annotations
+
+import os
 
 import jax
 import jax.numpy as jnp
 
 from distributedpytorch_tpu.ops.s2d import conv_same as _conv_same
+
+# Minimum channel count for the Pallas wgrad path: below a full lane tile
+# the kernel's (W+2, C) operands waste most of the vector unit and the
+# einsum path's XLA fusions win.
+_PALLAS_MIN_CHANNELS = 128
+
+
+def _wgrad_backend() -> str:
+    return os.environ.get("DPT_WGRAD_BACKEND", "einsum")
 
 
 @jax.custom_vjp
@@ -42,13 +62,8 @@ def _fwd(x, kernel):
     return _conv_same(x, kernel), (x, kernel)
 
 
-def _bwd(res, dy):
-    x, kernel = res
-    # dx: SAME conv of dY with the rotated, in/out-swapped kernel —
-    # kt[ky,kx,co,ci] = k[2−ky, 2−kx, ci, co] (exact for stride-1 SAME).
-    kt = kernel[::-1, ::-1].transpose(0, 1, 3, 2)
-    dx = _conv_same(dy, kt)
-
+def _wgrad_einsum(x, dy):
+    """dW (3,3,Cin,Cout) f32 as 9 shifted-view einsums."""
     b, h, w, _ = x.shape
     xp = jnp.pad(x, ((0, 0), (1, 1), (1, 1), (0, 0)))
     taps = []
@@ -65,7 +80,26 @@ def _bwd(res, dy):
                     preferred_element_type=jnp.float32,
                 )
             )
-    dk = jnp.stack(taps).reshape(3, 3, x.shape[3], kernel.shape[3])
+    return jnp.stack(taps).reshape(3, 3, x.shape[3], dy.shape[3])
+
+
+def _bwd(res, dy):
+    x, kernel = res
+    # dx: SAME conv of dY with the rotated, in/out-swapped kernel —
+    # kt[ky,kx,co,ci] = k[2−ky, 2−kx, ci, co] (exact for stride-1 SAME).
+    kt = kernel[::-1, ::-1].transpose(0, 1, 3, 2)
+    dx = _conv_same(dy, kt)
+
+    cin, cout = x.shape[3], kernel.shape[3]
+    if (
+        _wgrad_backend() == "pallas"
+        and min(cin, cout) >= _PALLAS_MIN_CHANNELS
+    ):
+        from distributedpytorch_tpu.ops.wgrad_pallas import wgrad_9tap_pallas
+
+        dk = wgrad_9tap_pallas(x, dy)
+    else:
+        dk = _wgrad_einsum(x, dy)
     return dx.astype(x.dtype), dk.astype(kernel.dtype)
 
 

@@ -4,12 +4,16 @@
 Measures, per hot s2d conv shape and for the full train step:
   (a) default backward (XLA conv-backward-filter + conv-backward-input)
   (b) --wgrad-taps backward (ops/conv_backward.py)
+and, with --backend pallas, a third leg:
+  (c) the taps backward with the single-pass Pallas wgrad kernel
+      (ops/wgrad_pallas.py) instead of the 9 einsums.
 
 Timings use the chained-dispatch method from round 3 (lax.scan over the
 op inside ONE dispatch, so per-dispatch tunnel latency cancels). Run on
 the TPU; prints one JSON line per measurement.
 
 Usage: python tools/bench_wgrad.py [--steps 10] [--full-step]
+       [--backend einsum|pallas|both]
 """
 
 import argparse
@@ -48,6 +52,10 @@ def main():
                     help="Also A/B the full reference-config train step")
     ap.add_argument("--tiny", action="store_true",
                     help="Tiny shapes (machinery smoke test off-TPU)")
+    ap.add_argument("--backend", choices=("einsum", "pallas", "both"),
+                    default="einsum",
+                    help="tap-contraction backend(s) to measure; the env "
+                    "var DPT_WGRAD_BACKEND is set per leg BEFORE tracing")
     args = ap.parse_args()
 
     import jax
@@ -55,7 +63,10 @@ def main():
     import numpy as np
 
     from distributedpytorch_tpu.cli import _enable_compilation_cache
-    from distributedpytorch_tpu.ops.conv_backward import conv3x3_same_taps
+    from distributedpytorch_tpu.ops.conv_backward import (
+        _PALLAS_MIN_CHANNELS,
+        conv3x3_same_taps,
+    )
     from distributedpytorch_tpu.ops.s2d import conv_same
 
     _enable_compilation_cache()
@@ -73,12 +84,34 @@ def main():
     ]
     if args.tiny:
         shapes = [(2, 16, 24, 8, 16)]
+    tap_backends = {
+        "einsum": ["einsum"], "pallas": ["pallas"],
+        "both": ["einsum", "pallas"],
+    }[args.backend]
+    legs = [("xla", conv_same, None)] + [
+        ("taps" if be == "einsum" else f"taps-{be}", conv3x3_same_taps, be)
+        for be in tap_backends
+    ]
     for b, h, w, ci, co in shapes:
         x = jnp.asarray(rng.random((b, h, w, ci), np.float32), jnp.bfloat16)
         k = jnp.asarray(rng.random((3, 3, ci, co), np.float32), jnp.bfloat16)
         flops = 2 * 9 * ci * co * b * h * w * 3  # fwd + dx + dw
 
-        for label, conv in (("xla", conv_same), ("taps", conv3x3_same_taps)):
+        for label, conv, backend in legs:
+            if backend == "pallas" and min(ci, co) < _PALLAS_MIN_CHANNELS:
+                # the dispatch gate would silently fall back to einsum —
+                # a mislabeled duplicate row, not a measurement
+                print(json.dumps({
+                    "shape": f"{ci}->{co}@{h}x{w}b{b}",
+                    "backward": label,
+                    "skipped": f"channels below the pallas gate "
+                               f"({_PALLAS_MIN_CHANNELS})",
+                }))
+                continue
+            if backend is not None:
+                # consulted at trace time; each leg compiles fresh
+                os.environ["DPT_WGRAD_BACKEND"] = backend
+
             def fwd_bwd(x, k, _conv=conv):
                 y, vjp = jax.vjp(_conv, x, k)
                 dx, dk = vjp(y)  # y as cotangent: right shape, no extra input
@@ -105,7 +138,16 @@ def main():
                 (rng.random((4, 640, 960)) > 0.5).astype(np.int32)
             ),
         }
-        for taps in (False, True):
+        step_legs = [("xla", False, None)] + [
+            ("taps" if be == "einsum" else f"taps-{be}", True, be)
+            for be in tap_backends
+        ]
+        for step_label, taps, backend in step_legs:
+            # NOTE: in the full step the pallas backend applies only to
+            # the >=128-channel convs (the dispatch gate); skinnier convs
+            # in the same step stay on einsum taps.
+            if backend is not None:
+                os.environ["DPT_WGRAD_BACKEND"] = backend
             model = UNet(dtype=jnp.bfloat16, wgrad_taps=taps)
             params = init_unet_params(model, jax.random.key(0), (640, 960))
             state, tx = create_train_state(params, 1e-4)
@@ -120,7 +162,7 @@ def main():
             float(loss)
             secs = (time.perf_counter() - t0) / reps
             print(json.dumps({
-                "full_step": "taps" if taps else "xla",
+                "full_step": step_label,
                 "ms": round(secs * 1e3, 1),
                 "imgs_per_sec": round(4 / secs, 1),
                 "loss": round(float(loss), 5),

@@ -28,9 +28,9 @@ echo "== bench: --wgrad-taps A/B"
 BENCH_WGRAD_TAPS=1 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
     python -u bench.py | tee "$OUT/bench_taps.json"
 
-echo "== per-shape + full-step wgrad A/B"
-timeout --signal=TERM 1800 \
-    python -u tools/bench_wgrad.py --steps 10 --full-step \
+echo "== per-shape + full-step wgrad A/B (xla vs einsum-taps vs pallas-taps)"
+timeout --signal=TERM 2400 \
+    python -u tools/bench_wgrad.py --steps 10 --full-step --backend both \
     | tee "$OUT/wgrad_ab.jsonl"
 
 echo "== post-run health probe (chip hygiene artifact)"
