@@ -299,26 +299,97 @@ class MultiProcessMixin:
       * batches assemble from process-local data into one global array.
 
     Requires `self.mesh` with a 'data' axis and `self.batch_sharding`.
+
+    Batch-dim sharding is by DATA ROW, not blindly by process. When the
+    mesh has axes besides 'data' (stage in DDP_MP, spatial in DDP_SP),
+    the devices of one data row can belong to SEVERAL processes — and
+    `make_array_from_process_local_data` takes each process's local data
+    as its own devices' shard content WITHOUT reconciling replicas, so
+    co-row processes feeding different samples silently build a
+    corrupted global batch (empirically: the same jitted `sum` of such a
+    batch returns DIFFERENT values on different processes — each sees
+    its own column's data; found by a 4-process × {data:2, stage:2}
+    probe in round 5). Processes sharing a data row must therefore load
+    the SAME samples; `_batch_replica_shard()` computes that row-based
+    assignment from the global mesh (identical on every process), and
+    both the train loader shard and the eval round-robin use it.
     """
 
+    def _batch_replica_shard(self) -> ShardSpec:
+        """(rank, world) for batch-dim loading: one shard per data ROW.
+
+        Fast path: when every data row's devices belong to one process
+        (1-axis DDP mesh; 2-proc × 2-device hybrids), this is the plain
+        process round-robin — maximal parallelism, no redundant loading.
+        When rows span processes, co-row processes get the SAME rank
+        (they must feed identical data — see class docstring). If the
+        topology is irregular (a process spanning rows that are also
+        shared, or processes orphaned by a shrunk mesh), fall back to
+        world=1 — every branch decides from the GLOBAL process→row map,
+        so all processes pick the same regime (divergence here would
+        mean different collective programs and a deadlock).
+
+        Memoized: the mesh and process layout are fixed for the
+        strategy's lifetime, and this sits on place_batch's per-step
+        host path — an O(devices) Python scan per batch key would be
+        real overhead on a pod."""
+        cached = getattr(self, "_replica_shard_memo", None)
+        if cached is not None:
+            return cached
+        spec = self._compute_batch_replica_shard()
+        self._replica_shard_memo = spec
+        return spec
+
+    def _compute_batch_replica_shard(self) -> ShardSpec:
+        if jax.process_count() == 1:
+            return ShardSpec(0, 1)
+        axis = self.mesh.axis_names.index("data")
+        grid = np.moveaxis(self.mesh.devices, axis, 0)
+        grid = grid.reshape(grid.shape[0], -1)
+        row_procs = [{d.process_index for d in row} for row in grid]
+        proc_rows = {}
+        for i, procs in enumerate(row_procs):
+            for p in procs:
+                proc_rows.setdefault(p, set()).add(i)
+        if set(proc_rows) != set(range(jax.process_count())):
+            return ShardSpec(0, 1)  # orphaned processes: replicate
+        if all(len(s) == 1 for s in row_procs):
+            return ShardSpec(jax.process_index(), jax.process_count())
+        if any(len(rows) != 1 for rows in proc_rows.values()):
+            return ShardSpec(0, 1)
+        my_row = next(iter(proc_rows[jax.process_index()]))
+        return ShardSpec(my_row, len(row_procs))
+
     def data_shard(self) -> ShardSpec:
-        return ShardSpec(jax.process_index(), jax.process_count())
+        return self._batch_replica_shard()
 
     def eval_shard(self) -> ShardSpec:
         """Multi-process strategies split evaluation: each process owns
         every world-th val batch and the grouped eval step psums nothing —
         per-batch metrics come back replicated from one sharded dispatch
-        (deliberate round-3 redundancy removed, VERDICT r03 next-4)."""
-        return ShardSpec(jax.process_index(), jax.process_count())
+        (deliberate round-3 redundancy removed, VERDICT r03 next-4).
+        Same row-based assignment as training (class docstring)."""
+        return self._batch_replica_shard()
 
     @property
     def global_batch_size(self) -> int:
-        return self.config.batch_size * jax.process_count()
+        # b × the number of DISTINCT batch shards (= data rows when rows
+        # span processes) — not × process_count: co-row processes feed
+        # the same samples, which add capacity only once.
+        return self.config.batch_size * self.data_shard().world
 
     def lr_for(self, base_lr: float) -> float:
         if self.config.ddp_lr_world_size_scaling:
             return base_lr * self.mesh.shape["data"]
         return base_lr
+
+    def _global_shape(self, local_shape) -> tuple:
+        """Global batch shape: dim 0 scales to the global batch; other
+        dims are supplied at FULL extent by every process and
+        `make_array_from_process_local_data` slices each device's part
+        (how the spatial axis of DDP_SP distributes without the loader
+        knowing about H-sharding — verified by the round-5 probe)."""
+        return (self.global_batch_size,) + tuple(local_shape[1:])
 
     def place_batch(self, batch):
         if jax.process_count() == 1:
@@ -326,7 +397,9 @@ class MultiProcessMixin:
                 k: jax.device_put(v, self.batch_sharding) for k, v in batch.items()
             }
         return {
-            k: jax.make_array_from_process_local_data(self.batch_sharding, v)
+            k: jax.make_array_from_process_local_data(
+                self.batch_sharding, v, global_shape=self._global_shape(v.shape)
+            )
             for k, v in batch.items()
         }
 
@@ -335,7 +408,12 @@ class MultiProcessMixin:
         if jax.process_count() == 1:
             return {k: jax.device_put(v, sharding) for k, v in stacked.items()}
         return {
-            k: jax.make_array_from_process_local_data(sharding, v)
+            k: jax.make_array_from_process_local_data(
+                sharding,
+                v,
+                global_shape=(v.shape[0],)
+                + self._global_shape(v.shape[1:]),
+            )
             for k, v in stacked.items()
         }
 
@@ -497,53 +575,9 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
     def drop_last_train(self) -> bool:
         return True
 
-    def eval_shard(self) -> ShardSpec:
-        """The grouped eval stack is sharded over 'data' but REPLICATED
-        over 'stage', so every process whose devices sit in the same data
-        row must feed the SAME val batch — `make_array_from_process_local_data`
-        does not cross-check replicas, and co-row processes feeding
-        different batches silently corrupts the stack (found by
-        test_four_process: 4 procs × 1 device on a {data:2, stage:2}
-        mesh produced ~2%-wrong val metrics).
-
-        Three regimes:
-          * every data row's devices belong to ONE process (e.g. 2 procs
-            × 2 local devices): the mixin's process round-robin is safe
-            and maximally parallel;
-          * some row spans processes but each process sits in exactly one
-            row: round-robin over DATA ROWS (world = data degree, rank =
-            this process's row) — co-row processes load identical
-            batches, redundant but consistent;
-          * anything else (some process spans rows while rows are also
-            shared): fall back to replicated evaluation rather than
-            corrupt.
-
-        Every branch is decided from the GLOBAL process→row map (the mesh
-        is identical on all processes), never from this process's own
-        placement alone — processes disagreeing on the regime would issue
-        different collective programs and deadlock the job at the first
-        eval."""
-        if jax.process_count() == 1:
-            return ShardSpec(0, 1)
-        row_procs = [
-            {d.process_index for d in row.flat} for row in self.mesh.devices
-        ]
-        proc_rows = {}
-        for i, procs in enumerate(row_procs):
-            for p in procs:
-                proc_rows.setdefault(p, set()).add(i)
-        # A shrunk mesh can orphan whole processes (dp capped by the batch
-        # leaves devs unused): round-robin over EITHER processes or rows
-        # would hand orphans batches no mesh shard consumes. Replicated
-        # fallback — and globally, so every process picks the same regime.
-        if set(proc_rows) != set(range(jax.process_count())):
-            return ShardSpec(0, 1)
-        if all(len(s) == 1 for s in row_procs):
-            return ShardSpec(jax.process_index(), jax.process_count())
-        if any(len(rows) != 1 for rows in proc_rows.values()):
-            return ShardSpec(0, 1)  # ALL processes take this branch
-        my_row = next(iter(proc_rows[jax.process_index()]))
-        return ShardSpec(my_row, len(row_procs))
+    # eval_shard / data_shard: the mixin's row-based assignment —
+    # co-row (stage-replica) processes load identical batches; see
+    # MultiProcessMixin._batch_replica_shard.
 
     def _loss_fn(self, model):
         return make_pipeline_loss_fn(

@@ -86,6 +86,21 @@ def main():
         trainer.strategy.eval_shard(),
     )
 
+    # Batch-assembly consistency: the same jitted reduction of a placed
+    # train batch must return the SAME value on every rank. Replica
+    # corruption (co-row processes feeding different data into a
+    # replicated shard — the round-5 {data:2, stage:2} × 4-process bug)
+    # manifests as rank-dependent sums of the "same" global array, which
+    # the loss-equality asserts alone cannot catch (the corruption is
+    # symmetric across replicas).
+    import jax.numpy as jnp
+
+    first = next(iter(trainer.train_loader.epoch_batches(0)))
+    placed = trainer.strategy.place_batch(first)
+    batch_sum = float(jax.jit(
+        lambda b: jnp.sum(b["image"]) + jnp.sum(b["mask"])
+    )(placed))
+
     params_host = jax.device_get(trainer.state.params)
     fingerprint = float(
         sum(float(np.abs(np.asarray(p)).sum()) for p in jax.tree.leaves(params_host))
@@ -101,6 +116,7 @@ def main():
                 "sharded_val": [sh_loss, sh_dice],
                 "steps": result["steps"],
                 "mesh_data": trainer.strategy.mesh.shape["data"],
+                "batch_sum": batch_sum,
             },
             f,
         )

@@ -102,6 +102,11 @@ def _assert_world(tmp_path, reports, method, mesh_data):
         # replicas identical after gradient all-reduce
         assert r["fingerprint"] == pytest.approx(r0["fingerprint"], rel=1e-6)
         assert r["steps"] == r0["steps"]
+        # batch assembly: the same jitted reduction of a placed global
+        # batch must agree on every rank — rank-dependent values mean a
+        # replicated shard holds different data on different devices
+        # (the round-5 co-row corruption signature)
+        assert r["batch_sum"] == pytest.approx(r0["batch_sum"], rel=1e-6)
     # sharded eval == replicated eval, on every rank, and identical values
     # across ranks (each rank loads only its own round-robin share; the
     # grouped dispatch's replicated out_shardings hands every rank the
@@ -122,23 +127,28 @@ def _assert_world(tmp_path, reports, method, mesh_data):
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("method,mesh_data", [("DDP", 4), ("DDP_MP", 2)])
+@pytest.mark.parametrize(
+    "method,mesh_data", [("DDP", 4), ("DDP_MP", 2), ("DDP_SP", 2)]
+)
 def test_two_process(tmp_path, method, mesh_data):
     """2 procs × 2 devices. DDP: 4-device global data mesh. DDP_MP:
     {data:2, stage:2} — crosses jax.distributed with the explicit pipeline
-    schedule (VERDICT r03 next-8)."""
+    schedule (VERDICT r03 next-8). DDP_SP: {data:2, spatial:2} — the
+    H-sliced batch placement over jax.distributed."""
     reports = _launch_world(tmp_path, world=2, local_devices=2, method=method)
     _assert_world(tmp_path, reports, method, mesh_data)
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("method,mesh_data", [("DDP", 4), ("DDP_MP", 2)])
+@pytest.mark.parametrize(
+    "method,mesh_data", [("DDP", 4), ("DDP_MP", 2), ("DDP_SP", 2)]
+)
 def test_four_process(tmp_path, method, mesh_data):
-    """4 procs × 1 device (VERDICT r04 next-6). For DDP_MP the process
-    count (4) equals NEITHER mesh axis ({data:2, stage:2}), so the
-    stage edge's ppermute and the gradient all-reduce both cross process
-    boundaries; for both methods the sharded evaluator's grouped
-    dispatch executes at world 4 (one exact 4-rank group, each rank
-    loading only its own batch)."""
+    """4 procs × 1 device (VERDICT r04 next-6). For the hybrids the
+    process count (4) equals NEITHER mesh axis ({data:2, stage:2} /
+    {data:2, spatial:2}), so co-row processes must feed identical data
+    into replicated/H-sliced shards (the row-based data_shard contract)
+    and the collectives cross process boundaries; the sharded
+    evaluator's grouped dispatch executes at its row world."""
     reports = _launch_world(tmp_path, world=4, local_devices=1, method=method)
     _assert_world(tmp_path, reports, method, mesh_data)

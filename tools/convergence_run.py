@@ -58,14 +58,28 @@ def main() -> int:
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--lr", type=float, default=1e-4)
     ap.add_argument("--outdir-tag", default="convergence_r05")
+    ap.add_argument("--model-arch", default="unet",
+                    choices=("unet", "milesial"),
+                    help="model family (milesial = the public 31M-param "
+                    "upstream architecture, reference modelsummary.txt:150-247)")
+    ap.add_argument("--data-dir", default=None,
+                    help="train from a Carvana-layout tree on disk instead "
+                    "of the in-memory synthetic dataset (used by the "
+                    "reference-parity program: both stacks read the same "
+                    "files)")
     args = ap.parse_args()
 
     from distributedpytorch_tpu.config import TrainConfig
     from distributedpytorch_tpu.train import Trainer
 
+    # Artifacts anchor to the repo, not the cwd — tools/parity_report.py
+    # reads them repo-anchored, and a run launched from elsewhere would
+    # otherwise scatter checkpoints/loss/logs under that cwd.
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     tag = args.outdir_tag
     config = TrainConfig(
         train_method="singleGPU",
+        model_arch=args.model_arch,
         epochs=args.epochs,
         learning_rate=args.lr,
         batch_size=args.batch_size,
@@ -73,10 +87,11 @@ def main() -> int:
         seed=42,
         compute_dtype="float32",
         image_size=tuple(args.image_size),
-        synthetic_samples=args.samples,
-        checkpoint_dir=os.path.join("checkpoints", tag),
-        log_dir=os.path.join("logs", tag),
-        loss_dir=os.path.join("loss", tag),
+        synthetic_samples=0 if args.data_dir else args.samples,
+        data_dir=args.data_dir or "./data",
+        checkpoint_dir=os.path.join(repo, "checkpoints", tag),
+        log_dir=os.path.join(repo, "logs", tag),
+        loss_dir=os.path.join(repo, "loss", tag),
         save_best=True,
         metric_every_steps=10,
         num_workers=0,

@@ -1,0 +1,222 @@
+#!/usr/bin/env python3
+"""Train the REFERENCE's torch model on the same data/split/config as this
+framework, for the first direct framework-vs-reference comparison.
+
+The north star is "matches or beats the reference ... at equal validation
+Dice", but the reference computes no Dice and no GPU exists here — so the
+comparison channel this script builds is: BOTH stacks train on the SAME
+synthetic Carvana-layout tree with the SAME train/val index split and the
+SAME hyperparameters on the SAME CPU, and `tools/parity_report.py` then
+evaluates BOTH checkpoints with THIS framework's loss/Dice on the same
+val subset (the torch weights enter through the tested `.pth` interop,
+checkpoint.import_reference_pth).
+
+This file contains NO reference code: it imports the reference's modules
+(`model.UNet`, `utils.utils.Loss`/`set_seed`, `utils.dataloading
+.BasicDataset`) from /root/reference at runtime and re-states the
+training semantics of reference utils/train_utils.py:22-96 in original
+code, with these documented deviations:
+  * device: CPU (the reference hardcodes ``.cuda(0)``; no GPU exists);
+  * resolution: configurable (default 192×128 — the reference hardcodes
+    960×640, far beyond a 1-core CPU budget);
+  * split: this framework's `seeded_split` indices via `torch.utils.data
+    .Subset`, so both stacks see literally the same train/val images
+    (the reference's `random_split(seed=0)` over an fs-ordered id list
+    is not reproducible across stacks; the reference dataset's ids are
+    sorted here for a well-defined index mapping);
+  * faithfully KEPT: Adam(lr, weight_decay=1e-8), ReduceLROnPlateau
+    (min, patience 2), the ``(batch_size · loss).backward()`` gradient
+    scaling (train_utils.py:69 — this framework mirrors it as
+    ``faithful_loss_scaling``), val loader drop_last, eval as mean
+    criterion over val batches (reference evaluate.py:16-19), the
+    (Step, Time, Loss)-every-10-steps metric rows, and set_seed(42).
+
+Usage:
+    python tools/reference_parity_run.py [--epochs 10] [--samples 160]
+        [--image-size 192 128] [--out .scratch/parity_ref]
+Writes <out>/singleGPU.pth, <out>/{train_loss,val_loss}.pkl (reference
+pickle schema) and <out>/summary.json (imgs/s, final losses).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REFERENCE = "/root/reference"
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--samples", type=int, default=160)
+    ap.add_argument("--image-size", type=int, nargs=2, default=(192, 128),
+                    metavar=("W", "H"))
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--lr", type=float, default=1e-4)
+    ap.add_argument("--tree", default=os.path.join(REPO, ".scratch",
+                                                   "parity_tree"))
+    ap.add_argument("--out", default=os.path.join(REPO, ".scratch",
+                                                  "parity_ref"))
+    args = ap.parse_args()
+
+    import numpy as np
+    import pandas as pd
+    import torch
+    from torch.utils.data import DataLoader, Subset
+
+    from distributedpytorch_tpu.data.dataset import (
+        write_synthetic_carvana_tree,
+    )
+    from distributedpytorch_tpu.data.loader import seeded_split
+
+    # -- the shared tree (deterministic; both stacks train on these files)
+    images_dir = os.path.join(args.tree, "train_hq")
+    if not (os.path.isdir(images_dir)
+            and len(os.listdir(images_dir)) == args.samples):
+        write_synthetic_carvana_tree(
+            args.tree, n=args.samples, size_wh=tuple(args.image_size), seed=0
+        )
+
+    # -- torchvision shim: the image ships no torchvision, and the
+    # reference imports exactly one symbol from it — CenterCrop, applied
+    # to skip tensors with a target (h, w) taken from a same-or-smaller
+    # upsampled tensor (reference model/unet_parts.py:58-73). Provide the
+    # torchvision semantics (center crop; symmetric zero-pad if the
+    # target exceeds the input) so the reference model runs unmodified.
+    import types
+
+    class _CenterCrop:
+        def __init__(self, size):
+            self.size = (
+                (int(size), int(size))
+                if isinstance(size, int)
+                else (int(size[0]), int(size[1]))
+            )
+
+        def __call__(self, t):
+            th, tw = self.size
+            h, w = t.shape[-2], t.shape[-1]
+            if th > h or tw > w:
+                ph, pw = max(th - h, 0), max(tw - w, 0)
+                t = torch.nn.functional.pad(
+                    t, (pw // 2, pw - pw // 2, ph // 2, ph - ph // 2)
+                )
+                h, w = t.shape[-2], t.shape[-1]
+            top, left = (h - th) // 2, (w - tw) // 2
+            return t[..., top:top + th, left:left + tw]
+
+    tv = types.ModuleType("torchvision")
+    tvt = types.ModuleType("torchvision.transforms")
+    tvt.CenterCrop = _CenterCrop
+    tv.transforms = tvt
+    sys.modules.setdefault("torchvision", tv)
+    sys.modules.setdefault("torchvision.transforms", tvt)
+
+    # -- reference modules, imported from the reference checkout
+    sys.path.insert(0, REFERENCE)
+    from model import UNet  # noqa: E402  (reference model/)
+    from utils.dataloading import BasicDataset  # noqa: E402
+    from utils.utils import Loss, set_seed  # noqa: E402
+
+    set_seed(42)  # reference train.py:36
+    ds = BasicDataset(
+        os.path.join(args.tree, "train_hq"),
+        os.path.join(args.tree, "train_masks"),
+        list(args.image_size),
+        mask_suffix="_mask",
+    )
+    ds.ids.sort()  # listdir order is fs-dependent; sorted = this
+    # framework's ordering, so indices mean the same images
+    train_idx, val_idx = seeded_split(len(ds), 0.10, seed=0)
+    train_loader = DataLoader(
+        Subset(ds, [int(i) for i in train_idx]),
+        batch_size=args.batch_size, shuffle=True, num_workers=0,
+    )
+    val_loader = DataLoader(
+        Subset(ds, [int(i) for i in val_idx]),
+        batch_size=args.batch_size, shuffle=False, drop_last=True,
+        num_workers=0,
+    )
+
+    model = UNet()
+    criterion = Loss()
+    optimizer = torch.optim.Adam(
+        model.parameters(), lr=args.lr, weight_decay=1e-8
+    )
+    scheduler = torch.optim.lr_scheduler.ReduceLROnPlateau(
+        optimizer, "min", patience=2
+    )
+
+    os.makedirs(args.out, exist_ok=True)
+    train_rows, val_rows = [], []
+    global_step = 0
+    imgs_done = 0
+    t_start = time.time()
+    for epoch in range(args.epochs):
+        model.train()
+        losses = []
+        for batch in train_loader:
+            images = batch["image"].to(torch.float32)
+            true_masks = batch["mask"].to(torch.float32).unsqueeze(1)
+            pred = model(images)
+            loss = criterion(pred, true_masks)
+            optimizer.zero_grad()
+            losses.append(float(loss.item()))
+            # reference train_utils.py:69 — gradient scale kept faithfully
+            (args.batch_size * loss).backward()
+            optimizer.step()
+            global_step += 1
+            imgs_done += images.shape[0]
+            if global_step % 10 == 0:
+                train_rows.append(
+                    [global_step, time.time() - t_start,
+                     float(np.mean(losses[-10:]))]
+                )
+        # epoch-end eval: mean criterion over val batches
+        # (reference evaluate.py:16-19)
+        model.eval()
+        vlosses = []
+        with torch.no_grad():
+            for batch in val_loader:
+                images = batch["image"].to(torch.float32)
+                true_masks = batch["mask"].to(torch.float32).unsqueeze(1)
+                vlosses.append(float(criterion(model(images), true_masks)))
+        val_loss = float(np.mean(vlosses)) if vlosses else float("nan")
+        val_rows.append([global_step, time.time() - t_start, val_loss])
+        scheduler.step(val_loss)
+        print(f"epoch {epoch + 1}/{args.epochs}: val loss {val_loss:.4f}",
+              flush=True)
+
+    elapsed = time.time() - t_start
+    torch.save(model.state_dict(), os.path.join(args.out, "singleGPU.pth"))
+    pd.DataFrame(train_rows, columns=["Step", "Time", "Loss"]).to_pickle(
+        os.path.join(args.out, "train_loss.pkl"))
+    pd.DataFrame(val_rows, columns=["Step", "Time", "Loss"]).to_pickle(
+        os.path.join(args.out, "val_loss.pkl"))
+    summary = {
+        "stack": "reference (torch CPU)",
+        "epochs": args.epochs,
+        "samples": args.samples,
+        "image_size": list(args.image_size),
+        "batch_size": args.batch_size,
+        "learning_rate": args.lr,
+        "steps": global_step,
+        "final_val_loss": val_rows[-1][2] if val_rows else None,
+        "train_imgs_per_sec": round(imgs_done / elapsed, 3),
+        "elapsed_s": round(elapsed, 1),
+        "torch_threads": torch.get_num_threads(),
+    }
+    with open(os.path.join(args.out, "summary.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
