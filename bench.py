@@ -87,12 +87,20 @@ _START = time.monotonic()
 BATCH = int(os.environ.get("BENCH_BATCH", 4))
 H = int(os.environ.get("BENCH_H", 640))
 W = int(os.environ.get("BENCH_W", 960))
+# Validated at module load so a typo'd arch fails loudly instead of
+# benching the unet under a mislabeled metric name; ARCH also names the
+# error/timeout/preflight metric series so a milesial run's failure is
+# never misfiled into the unet series.
+ARCH = os.environ.get("BENCH_ARCH", "unet")
+if ARCH not in ("unet", "milesial"):
+    raise SystemExit(f"BENCH_ARCH={ARCH!r}: expected 'unet' or 'milesial'")
 WARMUP_STEPS = 3
 MEASURE_STEPS = int(os.environ.get("BENCH_STEPS", 20))
 # Steps fused per dispatch for the headline number (the trainer's
 # --steps-per-dispatch path): on a remote/tunneled PJRT runtime per-dispatch
 # latency (~50 ms measured here) otherwise dominates the ~chip-time step.
-FUSED_STEPS = 10
+# Overridable for quick CPU smoke runs (the K-step scan dominates compile).
+FUSED_STEPS = int(os.environ.get("BENCH_FUSED_STEPS", 10))
 
 # Analytic per-image LOGICAL (pixel-domain) FLOPs at 640×960: forward = sum
 # of 2·K²·Cin·Cout·Hout·Wout over every conv/deconv in the 4-level UNet
@@ -288,13 +296,38 @@ def run() -> dict:
         make_train_step,
     )
 
-    # A/B levers for on-chip experiments (default = shipping config)
-    model = UNet(
-        dtype=jnp.bfloat16,
-        wgrad_taps=os.environ.get("BENCH_WGRAD_TAPS") == "1",
-    )
-    params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
-    state, tx = create_train_state(params, 1e-4)
+    # A/B levers for on-chip experiments (default = shipping config):
+    #   BENCH_WGRAD_TAPS=1    9-tap-matmul conv weight gradients
+    #   BENCH_S2D_LEVELS=N    force space-to-depth depth (-1 = auto)
+    #   BENCH_ARCH=milesial   the 31M-param public-upstream family
+    #   BENCH_PALLAS_LOSS=1   fused one-pass Pallas training loss
+    arch = ARCH
+    wgrad_taps = os.environ.get("BENCH_WGRAD_TAPS") == "1"
+    s2d_levels = int(os.environ.get("BENCH_S2D_LEVELS", "-1"))
+    if arch == "milesial":
+        from distributedpytorch_tpu.models.milesial import (
+            MilesialUNet,
+            init_milesial,
+        )
+
+        model = MilesialUNet(
+            dtype=jnp.bfloat16, s2d_levels=s2d_levels, wgrad_taps=wgrad_taps
+        )
+        params, model_state = init_milesial(
+            model, jax.random.key(0), input_hw=(H, W)
+        )
+    else:
+        model = UNet(
+            dtype=jnp.bfloat16, s2d_levels=s2d_levels, wgrad_taps=wgrad_taps
+        )
+        params = init_unet_params(model, jax.random.key(0), input_hw=(H, W))
+        model_state = None
+    state, tx = create_train_state(params, 1e-4, model_state=model_state)
+    loss_impl = None
+    if os.environ.get("BENCH_PALLAS_LOSS") == "1":
+        from distributedpytorch_tpu.ops.fused_loss import fused_bce_dice_loss
+
+        loss_impl = fused_bce_dice_loss
 
     rng = np.random.default_rng(0)
     dev = jax.devices()[0]
@@ -314,7 +347,7 @@ def run() -> dict:
 
     # AOT-compile once; the same executables are what we time (no hidden
     # recompiles, and cost_analysis reads the very computation measured).
-    step_fn = make_train_step(model, tx, batch_size=BATCH)
+    step_fn = make_train_step(model, tx, batch_size=BATCH, loss_impl=loss_impl)
     compiled = (
         jax.jit(step_fn, donate_argnums=(0,)).lower(state, batch).compile()
     )
@@ -350,7 +383,13 @@ def run() -> dict:
     if flops_executed <= 0:
         flops_executed = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
         flops_source = "analytic"
-    flops_logical = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
+    # The analytic logical count is the 7.76M-param UNet's conv sum; for
+    # the milesial family MFU has no precomputed denominator here, so its
+    # rows report executed-FLOP utilization only.
+    if arch == "unet":
+        flops_logical = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
+    else:
+        flops_logical = None
 
     # -- unfused: one dispatch per step --------------------------------------
     for _ in range(WARMUP_STEPS):
@@ -388,7 +427,7 @@ def run() -> dict:
     imgs_per_sec = BATCH / per_step
     peak = chip_peak_flops(dev)
     return {
-        "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
+        "metric": f"{arch}_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
         "value": round(imgs_per_sec, 2),
         "unit": "imgs/sec",
         **_baseline_fields(imgs_per_sec),
@@ -398,11 +437,17 @@ def run() -> dict:
         # logical = pixel-domain model FLOPs (the work a user asked for);
         # executed = what the compiled s2d computation runs (incl. its
         # structural zeros). MFU uses logical; hw_utilization uses executed.
-        "flops_per_img": round(flops_logical / BATCH / 1e9, 2),  # GFLOP
+        "flops_per_img": (
+            round(flops_logical / BATCH / 1e9, 2)  # GFLOP
+            if flops_logical is not None else None
+        ),
         "flops_per_img_executed": round(flops_executed / BATCH / 1e9, 2),
         "flops_source": flops_source,
         "achieved_tflops": round(flops_executed / per_step / 1e12, 2),
-        "mfu": round(flops_logical / per_step / peak, 4) if peak > 0 else None,
+        "mfu": (
+            round(flops_logical / per_step / peak, 4)
+            if peak > 0 and flops_logical is not None else None
+        ),
         "hw_utilization": (
             round(flops_executed / per_step / peak, 4) if peak > 0 else None
         ),
@@ -425,7 +470,7 @@ def _arm_watchdog(seconds: float) -> None:
 
     def fire():
         print(json.dumps({
-            "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_timeout",
+            "metric": f"{ARCH}_train_imgs_per_sec_b{BATCH}_{H}x{W}_timeout",
             "value": 0.0,
             "unit": "imgs/sec",
             **_baseline_fields(0.0),
@@ -460,7 +505,7 @@ def main():
         }
         if not ok:
             print(json.dumps({
-                "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_preflight",
+                "metric": f"{ARCH}_train_imgs_per_sec_b{BATCH}_{H}x{W}_preflight",
                 "value": 0.0,
                 "unit": "imgs/sec",
                 **_baseline_fields(0.0),
@@ -506,7 +551,7 @@ def main():
             os.execve(sys.executable,
                       [sys.executable, os.path.abspath(__file__)], env)
         result = {  # the artifact must never be empty/unparseable
-            "metric": f"unet_train_imgs_per_sec_b{BATCH}_{H}x{W}_error",
+            "metric": f"{ARCH}_train_imgs_per_sec_b{BATCH}_{H}x{W}_error",
             "value": 0.0,
             "unit": "imgs/sec",
             **_baseline_fields(0.0),

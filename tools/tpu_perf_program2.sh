@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# Round-5 session extension to tools/tpu_perf_program.sh — the measurements
+# the staged program doesn't carry: the full-resolution on-chip convergence
+# run (the val-Dice half of the north star, at the reference config), the
+# fused-Pallas-loss delta, the milesial s2d A/B, a fresh pixel-domain
+# anchor, and a batch-8 scaling point. Ordered most-valuable-first so a
+# chip that dies mid-program still leaves the best evidence.
+#
+# Channel discipline: ONE TPU client at a time — stop tools/tpu_watch.py
+# before running this (a concurrent probe is the two-client wedge).
+#
+#   bash tools/tpu_perf_program2.sh [outdir]
+set -u
+OUT="${1:-.perf_r05}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+echo "== on-chip full-resolution convergence run (north-star val-Dice)"
+timeout --signal=TERM 3600 \
+    python -u tools/convergence_run.py --tpu --image-size 960 640 \
+    --steps-per-dispatch 8 --outdir-tag convergence_r05_tpu \
+    2>&1 | tee "$OUT/convergence_tpu.log"
+
+echo "== bench: fused Pallas training loss delta"
+BENCH_PALLAS_LOSS=1 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_pallas_loss.json"
+
+echo "== bench: --wgrad-taps retry with compile-sized budget"
+# The staged program's attempt hit its 1200 s watchdog mid-compile (the
+# 9-tap formulation is a much larger XLA graph; >20 min to compile over
+# the tunnel, observed 01:06-01:26 this session).
+BENCH_WGRAD_TAPS=1 BENCH_WATCHDOG_SECS=2700 timeout --signal=TERM 2800 \
+    python -u bench.py | tee "$OUT/bench_taps_retry.json"
+
+echo "== bench: milesial, s2d default"
+BENCH_ARCH=milesial BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_milesial_s2d.json"
+
+echo "== bench: milesial, pixel domain"
+BENCH_ARCH=milesial BENCH_S2D_LEVELS=0 BENCH_WATCHDOG_SECS=1200 \
+    timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_milesial_pixel.json"
+
+echo "== bench: unet pixel-domain anchor (s2d off)"
+BENCH_S2D_LEVELS=0 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_pixel.json"
+
+echo "== bench: batch-8 scaling point"
+BENCH_BATCH=8 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
+    python -u bench.py | tee "$OUT/bench_b8.json"
+
+echo "== post-run health probe"
+python tools/tpu_health.py --timeout 300 --out "$OUT/health_post2.json"
+cp "$OUT/health_post2.json" TPU_HEALTH.json
+echo "done — artifacts in $OUT/"
