@@ -378,14 +378,21 @@ def run() -> dict:
     # logical count comes from ONE source in every mode — the analytic conv
     # sum, which scales linearly with H·W — so MFU ratios between execution
     # modes always track measured imgs/sec ratios.
+    # The analytic conv sum is the 7.76M-param UNet's; it must never fill
+    # a milesial row (≈4× the params — the FLOP fields would be silently
+    # ~4× off under a milesial_... metric name). milesial rows without
+    # cost_analysis report their FLOP-derived fields as null instead.
     flops_executed = xla_step_flops(compiled)
     flops_source = "xla_cost_analysis"
     if flops_executed <= 0:
-        flops_executed = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
-        flops_source = "analytic"
-    # The analytic logical count is the 7.76M-param UNet's conv sum; for
-    # the milesial family MFU has no precomputed denominator here, so its
-    # rows report executed-FLOP utilization only.
+        if arch == "unet":
+            flops_executed = (
+                ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
+            )
+            flops_source = "analytic"
+        else:
+            flops_executed = None
+            flops_source = "unavailable"
     if arch == "unet":
         flops_logical = ANALYTIC_STEP_FLOPS_PER_IMG * BATCH * (H * W) / (640 * 960)
     else:
@@ -441,15 +448,22 @@ def run() -> dict:
             round(flops_logical / BATCH / 1e9, 2)  # GFLOP
             if flops_logical is not None else None
         ),
-        "flops_per_img_executed": round(flops_executed / BATCH / 1e9, 2),
+        "flops_per_img_executed": (
+            round(flops_executed / BATCH / 1e9, 2)
+            if flops_executed is not None else None
+        ),
         "flops_source": flops_source,
-        "achieved_tflops": round(flops_executed / per_step / 1e12, 2),
+        "achieved_tflops": (
+            round(flops_executed / per_step / 1e12, 2)
+            if flops_executed is not None else None
+        ),
         "mfu": (
             round(flops_logical / per_step / peak, 4)
             if peak > 0 and flops_logical is not None else None
         ),
         "hw_utilization": (
-            round(flops_executed / per_step / peak, 4) if peak > 0 else None
+            round(flops_executed / per_step / peak, 4)
+            if peak > 0 and flops_executed is not None else None
         ),
         "device_kind": getattr(dev, "device_kind", dev.platform),
     }

@@ -44,7 +44,7 @@ def test_watcher_fires_program_once(tmp_path, monkeypatch):
     monkeypatch.setattr(tpu_watch, "_probe_once", lambda t: next(results))
     monkeypatch.setattr(
         tpu_watch, "fire_perf_program",
-        lambda out, log: fired.append(out) or 0)
+        lambda out, log, program=None: fired.append((out, program)) or 0)
     monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
 
     # 4 polls inside the deadline, then stop
@@ -55,10 +55,12 @@ def test_watcher_fires_program_once(tmp_path, monkeypatch):
         sys, "argv",
         ["tpu_watch.py", "--ledger", str(ledger), "--interval", "1",
          "--post-interval", "1", "--probe-timeout", "1",
-         "--max-hours", str(20 / 3600.0), "--perf-out", str(outdir)])
+         "--max-hours", str(20 / 3600.0), "--perf-out", str(outdir),
+         "--program", "tools/prog.sh"])
     assert tpu_watch.main() == 0
 
-    assert fired == [str(outdir)]  # fired exactly once
+    # fired exactly once, with the configured program passed through
+    assert fired == [(str(outdir), "tools/prog.sh")]
     assert os.path.exists(outdir / "FIRED")
     events = [r["event"] for r in _read(ledger)]
     assert events[0] == "watcher_start"

@@ -2,54 +2,84 @@
 # Round-5 session extension to tools/tpu_perf_program.sh — the measurements
 # the staged program doesn't carry: the full-resolution on-chip convergence
 # run (the val-Dice half of the north star, at the reference config), the
-# fused-Pallas-loss delta, the milesial s2d A/B, a fresh pixel-domain
-# anchor, and a batch-8 scaling point. Ordered most-valuable-first so a
-# chip that dies mid-program still leaves the best evidence.
+# fused-Pallas-loss delta, a --wgrad-taps retry at a compile-sized budget,
+# the milesial s2d A/B, a fresh pixel-domain anchor, and a batch-8 scaling
+# point. Ordered most-valuable-first so a chip that dies mid-program still
+# leaves the best evidence.
+#
+# Retry contract with tools/tpu_watch.py: the watcher re-fires a program
+# whose rc != 0 (bounded, 3 attempts). This script exits nonzero unless
+# EVERY leg produced its artifact, and each leg SKIPS itself when its
+# artifact already holds a successful result — so a re-fire after a
+# mid-program chip death resumes where the last attempt stopped instead
+# of re-spending hours of chip time.
 #
 # Channel discipline: ONE TPU client at a time — stop tools/tpu_watch.py
-# before running this (a concurrent probe is the two-client wedge).
+# before running this by hand (a concurrent probe is the two-client wedge).
 #
 #   bash tools/tpu_perf_program2.sh [outdir]
-set -u
+set -u -o pipefail
+cd "$(dirname "$0")/.."
 OUT="${1:-.perf_r05}"
 mkdir -p "$OUT"
-cd "$(dirname "$0")/.."
+OUT="$(cd "$OUT" && pwd)"
+RC=0
+
+echo "== pre-flight health probe"
+if ! python tools/tpu_health.py --timeout 300 --out "$OUT/health_pre2.json"; then
+    echo "runtime unhealthy — aborting (see $OUT/health_pre2.json)"
+    exit 1
+fi
+
+# A bench leg is done iff its artifact is a JSON line without an "error"
+# field (watchdog/preflight/exception paths all carry one).
+bench_done() { [ -s "$1" ] && ! grep -q '"error"' "$1"; }
 
 echo "== on-chip full-resolution convergence run (north-star val-Dice)"
-timeout --signal=TERM 3600 \
-    python -u tools/convergence_run.py --tpu --image-size 960 640 \
-    --steps-per-dispatch 8 --outdir-tag convergence_r05_tpu \
-    2>&1 | tee "$OUT/convergence_tpu.log"
+if [ -s logs/convergence_r05_tpu/run.json ]; then
+    echo "skip: logs/convergence_r05_tpu/run.json already present"
+else
+    timeout --signal=TERM 3600 \
+        python -u tools/convergence_run.py --tpu --image-size 960 640 \
+        --steps-per-dispatch 8 --outdir-tag convergence_r05_tpu \
+        2>&1 | tee "$OUT/convergence_tpu.log" || RC=1
+    [ -s logs/convergence_r05_tpu/run.json ] || RC=1
+fi
+
+run_bench() { # run_bench <artifact> [ENV=VAL ...]
+    local artifact="$1"; shift
+    if bench_done "$artifact"; then
+        echo "skip: $artifact already holds a successful result"
+        return 0
+    fi
+    env "$@" BENCH_WATCHDOG_SECS="${WATCHDOG:-1200}" \
+        timeout --signal=TERM "$(( ${WATCHDOG:-1200} + 100 ))" \
+        python -u bench.py | tee "$artifact"
+    bench_done "$artifact" || RC=1
+}
 
 echo "== bench: fused Pallas training loss delta"
-BENCH_PALLAS_LOSS=1 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
-    python -u bench.py | tee "$OUT/bench_pallas_loss.json"
+run_bench "$OUT/bench_pallas_loss.json" BENCH_PALLAS_LOSS=1
 
 echo "== bench: --wgrad-taps retry with compile-sized budget"
 # The staged program's attempt hit its 1200 s watchdog mid-compile (the
-# 9-tap formulation is a much larger XLA graph; >20 min to compile over
-# the tunnel, observed 01:06-01:26 this session).
-BENCH_WGRAD_TAPS=1 BENCH_WATCHDOG_SECS=2700 timeout --signal=TERM 2800 \
-    python -u bench.py | tee "$OUT/bench_taps_retry.json"
+# 9-tap formulation is a much larger XLA graph — and the chip died).
+WATCHDOG=2700 run_bench "$OUT/bench_taps_retry.json" BENCH_WGRAD_TAPS=1
 
 echo "== bench: milesial, s2d default"
-BENCH_ARCH=milesial BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
-    python -u bench.py | tee "$OUT/bench_milesial_s2d.json"
+run_bench "$OUT/bench_milesial_s2d.json" BENCH_ARCH=milesial
 
 echo "== bench: milesial, pixel domain"
-BENCH_ARCH=milesial BENCH_S2D_LEVELS=0 BENCH_WATCHDOG_SECS=1200 \
-    timeout --signal=TERM 1300 \
-    python -u bench.py | tee "$OUT/bench_milesial_pixel.json"
+run_bench "$OUT/bench_milesial_pixel.json" BENCH_ARCH=milesial BENCH_S2D_LEVELS=0
 
 echo "== bench: unet pixel-domain anchor (s2d off)"
-BENCH_S2D_LEVELS=0 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
-    python -u bench.py | tee "$OUT/bench_pixel.json"
+run_bench "$OUT/bench_pixel.json" BENCH_S2D_LEVELS=0
 
 echo "== bench: batch-8 scaling point"
-BENCH_BATCH=8 BENCH_WATCHDOG_SECS=1200 timeout --signal=TERM 1300 \
-    python -u bench.py | tee "$OUT/bench_b8.json"
+run_bench "$OUT/bench_b8.json" BENCH_BATCH=8
 
 echo "== post-run health probe"
-python tools/tpu_health.py --timeout 300 --out "$OUT/health_post2.json"
+python tools/tpu_health.py --timeout 300 --out "$OUT/health_post2.json" || RC=1
 cp "$OUT/health_post2.json" TPU_HEALTH.json
-echo "done — artifacts in $OUT/"
+echo "done (rc=$RC) — artifacts in $OUT/"
+exit $RC

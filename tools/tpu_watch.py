@@ -77,7 +77,8 @@ def append_ledger(path: str, record: dict) -> None:
         os.fsync(f.fileno())
 
 
-def fire_perf_program(outdir: str, log_path: str) -> int:
+def fire_perf_program(outdir: str, log_path: str,
+                      program: str = None) -> int:
     """Run the measurement program, tee-ing output to a log file. No
     timeout here beyond the program's own per-step timeouts — the program
     already bounds each TPU step (SIGTERM-only) and writes artifacts as
@@ -85,10 +86,11 @@ def fire_perf_program(outdir: str, log_path: str) -> int:
     watcher started from anywhere must still find the program when the
     chip finally answers."""
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if program is None:
+        program = os.path.join(repo, "tools", "tpu_perf_program.sh")
     with open(log_path, "a") as log:
         proc = subprocess.Popen(
-            ["bash", os.path.join(repo, "tools", "tpu_perf_program.sh"),
-             outdir],
+            ["bash", program, outdir],
             cwd=repo, stdout=log, stderr=subprocess.STDOUT,
         )
         return proc.wait()
@@ -122,10 +124,21 @@ def main() -> int:
     ap.add_argument("--probe-timeout", type=float, default=300.0)
     ap.add_argument("--max-hours", type=float, default=11.5)
     ap.add_argument("--perf-out", default=os.path.join(repo, ".perf_r05"))
+    ap.add_argument("--program",
+                    default=os.path.join(repo, "tools",
+                                         "tpu_perf_program.sh"),
+                    help="measurement program to fire on the first healthy "
+                    "probe (e.g. tools/tpu_perf_program2.sh for the round-5 "
+                    "follow-ups)")
+    ap.add_argument("--fired-marker", default="FIRED",
+                    help="one-shot marker filename under --perf-out; give "
+                    "each program its own marker so firing program A never "
+                    "disables program B")
     args = ap.parse_args()
 
     deadline = time.monotonic() + args.max_hours * 3600.0
-    fired = _fired_successfully(os.path.join(args.perf_out, "FIRED"))
+    fired = _fired_successfully(os.path.join(args.perf_out,
+                                             args.fired_marker))
     fire_attempts = 0
     attempt = 0
     append_ledger(args.ledger, {
@@ -162,9 +175,11 @@ def main() -> int:
         if result.get("ok") and not fired:
             os.makedirs(args.perf_out, exist_ok=True)
             append_ledger(args.ledger, {"event": "perf_program_start",
-                                        "outdir": args.perf_out})
+                                        "outdir": args.perf_out,
+                                        "program": args.program})
             rc = fire_perf_program(
-                args.perf_out, os.path.join(args.perf_out, "program.log"))
+                args.perf_out, os.path.join(args.perf_out, "program.log"),
+                args.program)
             fire_attempts += 1
             # A failed program run does NOT consume the one-shot: the
             # chip may have died mid-program; a later healthy probe
@@ -172,7 +187,8 @@ def main() -> int:
             # failing program can't churn the TPU every poll cycle.
             fired = rc == 0 or fire_attempts >= 3
             if fired:
-                with open(os.path.join(args.perf_out, "FIRED"), "w") as f:
+                with open(os.path.join(args.perf_out,
+                                       args.fired_marker), "w") as f:
                     f.write(_utcnow() + f" rc={rc} "
                             f"attempts={fire_attempts}\n")
             append_ledger(args.ledger, {"event": "perf_program_done",
