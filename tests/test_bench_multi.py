@@ -1,0 +1,232 @@
+"""tools/bench_multi.py: resume/poison-marking semantics and the
+single-process config-sequencing loop, with bench.run and the probe
+mocked (no TPU, no subprocesses).
+
+The contract under test is what protects chip windows: a config whose
+previous attempt wedged a window is never retried, a config that failed
+only because the runtime was already dead IS retried, and a mid-config
+process death is durably attributed to the config that caused it.
+"""
+
+import json
+import os
+import sys
+import types
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import bench_multi
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _write(path, objs):
+    with open(path, "w") as f:
+        for o in objs:
+            f.write(json.dumps(o) + "\n")
+
+
+class TestLoadState:
+    def test_empty_or_missing_artifact(self, tmp_path):
+        assert bench_multi.load_state(str(tmp_path / "none.jsonl")) == {}
+
+    def test_statuses(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        _write(p, [
+            {"config": "pixel", "value": 19.6},
+            {"config": "b8",
+             "error": "watchdog: no result after 1200s (compile wedged)"},
+            {"config": "milesial_s2d",
+             "error": "runtime_error: RuntimeError: UNAVAILABLE"},
+            {"config": "milesial_pixel",
+             "error": "config_error: ValueError: bad arch"},
+        ])
+        state = bench_multi.load_state(str(p))
+        assert state == {
+            "pixel": "ok",
+            "b8": "poison",
+            "milesial_s2d": "innocent",
+            "milesial_pixel": "permanent",
+        }
+
+    def test_attempting_without_result_is_poisoned_durably(self, tmp_path):
+        """A process killed mid-compile leaves only the marker; load_state
+        must both report poison AND write the attribution line so the
+        next read needs no marker inference."""
+        p = tmp_path / "a.jsonl"
+        _write(p, [
+            {"config": "pixel", "value": 19.6},
+            {"event": "attempting", "config": "pallas_loss"},
+        ])
+        state = bench_multi.load_state(str(p))
+        assert state["pallas_loss"] == "poison"
+        last = _lines(p)[-1]
+        assert last["config"] == "pallas_loss"
+        assert last["error"].startswith("wedged_previous_attempt")
+        # durable: a second parse sees the written line, not the marker
+        assert bench_multi.load_state(str(p))["pallas_loss"] == "poison"
+
+    def test_attempting_then_result_is_not_poisoned(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        _write(p, [
+            {"event": "attempting", "config": "pixel"},
+            {"config": "pixel", "value": 19.6},
+        ])
+        assert bench_multi.load_state(str(p))["pixel"] == "ok"
+
+
+class TestMainLoop:
+    def _fake_bench(self, results):
+        """A stand-in for the bench module: run() pops from `results`
+        (dict → return, Exception → raise)."""
+        mod = types.SimpleNamespace(BATCH=4, H=640, W=960, ARCH="unet",
+                                    _START=0.0)
+
+        def run():
+            r = results.pop(0)
+            if isinstance(r, Exception):
+                raise r
+            return r
+
+        mod.run = run
+        return mod
+
+    def _patch(self, monkeypatch, tmp_path, probe_ok, fake_mod, configs,
+               probes=None):
+        """probe_ok sets a constant probe result; probes (a list) makes
+        successive _probe_once calls pop from it instead (the liveness
+        re-probe after a retryable exception)."""
+        monkeypatch.setattr(bench_multi, "CONFIGS", configs)
+        monkeypatch.setattr(
+            bench_multi, "_CONFIG_ENV_KEYS",
+            sorted({k for _, env, _ in configs for k in env}))
+
+        def probe(t):
+            if probes is not None:
+                return probes.pop(0)
+            return ({"ok": True, "platform": "tpu"} if probe_ok
+                    else {"ok": False, "error": "probe timeout"})
+
+        # main() imports bench lazily; plant the fake in sys.modules
+        fake_mod._probe_once = probe
+        monkeypatch.setitem(sys.modules, "bench", fake_mod)
+
+    def test_all_configs_measured(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {"BENCH_S2D_LEVELS": "0"}, 60.0),
+                   ("b", {"BENCH_BATCH": "8"}, 60.0)]
+        mod = self._fake_bench([{"value": 1.0}, {"value": 2.0}])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 0
+        state = bench_multi.load_state(out)
+        assert state == {"a": "ok", "b": "ok"}
+        # config b's env must not have leaked config a's lever
+        assert os.environ.get("BENCH_S2D_LEVELS") is None
+
+    def test_resume_skips_ok_and_poison_retries_innocent(
+            self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        _write(out, [
+            {"config": "a", "value": 1.0},
+            {"config": "b", "error": "watchdog: no result after 60s"},
+            {"config": "c", "error": "runtime_error: RuntimeError: dead"},
+        ])
+        configs = [("a", {}, 60.0), ("b", {}, 60.0), ("c", {}, 60.0)]
+        mod = self._fake_bench([{"value": 3.0}])  # only c should run
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 0
+        assert bench_multi.load_state(out) == {
+            "a": "ok", "b": "poison", "c": "ok"}
+
+    def test_runtime_death_stops_sequence_innocent(
+            self, tmp_path, monkeypatch):
+        """A RuntimeError mid-sequence whose liveness re-probe FAILS
+        marks that config innocent (retryable next window) and stops —
+        later configs stay unattempted, so the program exits nonzero
+        and the watcher re-fires."""
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0), ("b", {}, 60.0), ("c", {}, 60.0)]
+        mod = self._fake_bench(
+            [{"value": 1.0}, RuntimeError("UNAVAILABLE: relay gone")])
+        self._patch(monkeypatch, tmp_path, True, mod, configs, probes=[
+            {"ok": True, "platform": "tpu"},   # session start
+            {"ok": False, "error": "probe timeout"},  # after the raise
+        ])
+        rc = bench_multi.main(["--out", out])
+        assert rc == 4
+        state = bench_multi.load_state(out)
+        assert state == {"a": "ok", "b": "innocent"}
+        assert "c" not in state
+
+    def test_runtime_error_with_live_runtime_is_permanent(
+            self, tmp_path, monkeypatch):
+        """JAX raises deterministic config failures as XlaRuntimeError (a
+        RuntimeError subclass); if the liveness re-probe still answers,
+        the config is marked permanent and the sequence CONTINUES — a
+        broken config must not starve the ones ordered after it."""
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0), ("b", {}, 60.0)]
+        mod = self._fake_bench(
+            [RuntimeError("INVALID_ARGUMENT: bad lowering"),
+             {"value": 2.0}])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 0
+        assert bench_multi.load_state(out) == {
+            "a": "permanent", "b": "ok"}
+
+    def test_deterministic_failure_continues(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0), ("b", {}, 60.0)]
+        mod = self._fake_bench([ValueError("bad"), {"value": 2.0}])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 0  # both terminally resolved (permanent + ok)
+        assert bench_multi.load_state(out) == {
+            "a": "permanent", "b": "ok"}
+
+    def test_dead_runtime_at_start(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0)]
+        mod = self._fake_bench([])
+        self._patch(monkeypatch, tmp_path, False, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 2
+        assert "a" not in bench_multi.load_state(out)
+
+    def test_nothing_todo(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        _write(out, [{"config": "a", "value": 1.0}])
+        configs = [("a", {}, 60.0)]
+        mod = self._fake_bench([])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        assert bench_multi.main(["--out", out]) == 0
+
+    def test_run_one_sets_module_config(self, monkeypatch):
+        """_run_one must re-derive bench's module globals per config —
+        they are frozen from env at bench import and would otherwise
+        mislabel every non-default config's metric series."""
+        captured = {}
+        mod = types.SimpleNamespace(BATCH=4, H=640, W=960, ARCH="unet",
+                                    _START=0.0)
+
+        def run():
+            captured.update(BATCH=mod.BATCH, ARCH=mod.ARCH,
+                            taps=os.environ.get("BENCH_WGRAD_TAPS"))
+            return {"value": 1.0}
+
+        mod.run = run
+        monkeypatch.delenv("BENCH_WGRAD_TAPS", raising=False)
+        bench_multi._run_one(
+            mod, "x", {"BENCH_BATCH": "8", "BENCH_ARCH": "milesial",
+                       "BENCH_WGRAD_TAPS": "1"}, 60.0)
+        assert captured == {"BATCH": 8, "ARCH": "milesial", "taps": "1"}
+        assert mod._START > 0.0
+        for k in ("BENCH_WGRAD_TAPS", "BENCH_ARCH", "BENCH_BATCH"):
+            os.environ.pop(k, None)

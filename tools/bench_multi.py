@@ -1,0 +1,249 @@
+#!/usr/bin/env python3
+"""Single-process multi-config on-chip A/B measurement with resume and
+poison-marking.
+
+Why one process, and why this ordering: both dead chip windows this
+round died during a FRESH heavy compile in a NEW process right after a
+prior process had used the runtime (window 1, 01:06Z: the 9-tap wgrad
+graph; window 2, 03:36Z: the Pallas fused-loss config) — while long
+single-process streams of ordinary compiles+dispatches ran fine (the
+19-minute, 360-step convergence run; `bench.py`'s own two-executable
+headline). So the remaining A/B program runs in ONE process, cheapest /
+proven-safe compile classes first and the two wedge-suspect compiles
+last, with:
+
+  * a JSONL artifact appended after EVERY config (a mid-program death
+    still leaves everything measured so far);
+  * an ``attempting`` marker before each config, so a process killed
+    mid-compile attributes the kill to the config that caused it;
+  * poison-marking — a config that watchdogged or whose attempt killed
+    the process is recorded and NEVER retried (re-running the killer
+    compile would just re-wedge the next chip window);
+  * resume — configs with a successful line are skipped, so the
+    watcher can re-fire this program across windows and it only ever
+    spends chip time on innocent unmeasured configs.
+
+Exit codes (the program wrapper's loop contract):
+  0 = every config terminally resolved (measured, poisoned, or failed
+      deterministically) — nothing left to spend chip time on
+  1 = innocent configs remain unmeasured (refire on a later window)
+  2 = runtime dead at start (nothing attempted)
+  3 = a config hit its watchdog (poison-marked; re-invoke to continue)
+  4 = runtime died mid-sequence (remaining configs stay innocent)
+
+Measurement methodology is `bench.py`'s own `run()` — same compiled
+executables, same chained-dispatch timing, same JSON fields — driven
+per-config by setting its module config; numbers land in the same
+metric series the driver's BENCH artifact uses.
+
+Reference anchor: the (Step,Time) instrumentation this program must
+beat lives at reference utils/train_utils.py:75-79.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (name, env overrides, per-config watchdog seconds). Order is the
+# safety story (see module docstring): pixel's compile class already
+# succeeded on this channel in round 3, b8 is the default graph at a
+# bigger batch, the milesial pair is plain XLA convs, and the two
+# wedge-suspects — the Pallas fused loss (killed window 2) and the
+# 9-tap wgrad graph (killed window 1) — go last, taps very last.
+CONFIGS = [
+    ("pixel", {"BENCH_S2D_LEVELS": "0"}, 1200.0),
+    ("b8", {"BENCH_BATCH": "8"}, 1200.0),
+    ("milesial_s2d", {"BENCH_ARCH": "milesial"}, 1500.0),
+    ("milesial_pixel",
+     {"BENCH_ARCH": "milesial", "BENCH_S2D_LEVELS": "0"}, 1500.0),
+    ("pallas_loss", {"BENCH_PALLAS_LOSS": "1"}, 1500.0),
+    ("wgrad_taps", {"BENCH_WGRAD_TAPS": "1"}, 2700.0),
+]
+
+# Every env key any config may set — popped between configs so a lever
+# can never leak from one config into the next.
+_CONFIG_ENV_KEYS = sorted({k for _, env, _ in CONFIGS for k in env})
+
+_POISON_PREFIXES = ("watchdog", "wedged_previous_attempt")
+_INNOCENT_PREFIX = "runtime_error"
+
+
+def append_line(path: str, obj: dict) -> None:
+    obj = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **obj}
+    with open(path, "a") as f:
+        f.write(json.dumps(obj) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def load_state(path: str) -> dict:
+    """Parse the artifact into {config_name: status}.
+
+    status: 'ok' (measured), 'poison' (this config wedged a window —
+    never retry), 'innocent' (failed because the runtime was already
+    dead — retry on a later window), 'permanent' (deterministic error).
+    An ``attempting`` marker with no following result line means the
+    process died mid-config: that config is poison-marked IN the
+    artifact so the attribution is durable, not re-derived.
+    """
+    state: dict = {}
+    attempting = None
+    try:
+        with open(path) as f:
+            lines = [json.loads(line) for line in f if line.strip()]
+    except OSError:
+        return state
+    for d in lines:
+        name = d.get("config")
+        if name is None:
+            continue
+        if d.get("event") == "attempting":
+            attempting = name
+            continue
+        attempting = None
+        err = d.get("error")
+        if err is None:
+            state[name] = "ok"
+        elif err.startswith(_POISON_PREFIXES):
+            state[name] = "poison"
+        elif err.startswith(_INNOCENT_PREFIX):
+            state[name] = "innocent"
+        else:
+            state[name] = "permanent"
+    if attempting is not None:
+        append_line(path, {
+            "config": attempting,
+            "error": "wedged_previous_attempt: process died mid-config "
+                     "(killed or crashed during compile/measure)",
+        })
+        state[attempting] = "poison"
+    return state
+
+
+def _arm_config_watchdog(path: str, name: str, secs: float):
+    """A wedged runtime hangs inside a native call no exception escapes;
+    only a timer thread + hard exit gets an attribution line written."""
+    def fire():
+        append_line(path, {
+            "config": name,
+            "error": f"watchdog: no result after {secs:.0f}s "
+                     "(compile wedged or runtime died mid-config)",
+        })
+        sys.stdout.flush()
+        os._exit(3)
+
+    t = threading.Timer(secs, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def _run_one(bench, name: str, env: dict, budget: float) -> dict:
+    """Point bench.py's module config at this config and run its
+    measurement path (same executables/timing/fields as the driver
+    artifact)."""
+    for k in _CONFIG_ENV_KEYS:
+        os.environ.pop(k, None)
+    os.environ.update(env)
+    # run() reads the lever envs itself but takes batch/arch/geometry
+    # from module globals frozen at bench import — re-derive them here.
+    bench.BATCH = int(env.get("BENCH_BATCH", 4))
+    bench.H = int(env.get("BENCH_H", 640))
+    bench.W = int(env.get("BENCH_W", 960))
+    bench.ARCH = env.get("BENCH_ARCH", "unet")
+    # run()'s fused-executable skip gate compares elapsed-since-_START
+    # against the watchdog budget; both must be per-config here.
+    bench._START = time.monotonic()
+    os.environ["BENCH_WATCHDOG_SECS"] = str(budget)
+    return bench.run()
+
+
+def main(argv=None) -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        repo, ".perf_r05", "bench_multi.jsonl"))
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    args = ap.parse_args(argv)
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+
+    state = load_state(args.out)
+    todo = [(n, e, b) for n, e, b in CONFIGS
+            if state.get(n) in (None, "innocent")]
+    if not todo:
+        print(f"bench_multi: all {len(CONFIGS)} configs terminally "
+              f"resolved in {args.out}")
+        return 0
+
+    from bench import _probe_once  # SIGTERM-only subprocess probe
+
+    probe = _probe_once(args.probe_timeout)
+    append_line(args.out, {"event": "session_start", "probe": probe,
+                           "todo": [n for n, _, _ in todo]})
+    if not probe.get("ok"):
+        print(f"bench_multi: runtime dead at start: {probe}")
+        return 2
+
+    import bench
+
+    try:
+        for name, env, budget in todo:
+            append_line(args.out, {"event": "attempting", "config": name,
+                                   "budget_s": budget})
+            dog = _arm_config_watchdog(args.out, name, budget)
+            try:
+                result = _run_one(bench, name, env, budget)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                dog.cancel()
+                retryable = isinstance(
+                    exc,
+                    (RuntimeError, OSError, ConnectionError, TimeoutError))
+                # JAX surfaces deterministic config failures as
+                # XlaRuntimeError (a RuntimeError subclass) too — only a
+                # liveness probe can tell "the runtime died under this
+                # config" from "this config is just broken". A dead
+                # probe → innocent (a later window retries) and stop:
+                # nothing after it can init a backend in this process
+                # (jax caches the failed init). A healthy probe → the
+                # config itself failed deterministically → permanent,
+                # keep going with the rest.
+                if retryable and not _probe_once(
+                        args.probe_timeout).get("ok"):
+                    append_line(args.out, {
+                        "config": name,
+                        "error":
+                            f"runtime_error: {type(exc).__name__}: {exc}",
+                    })
+                    print(f"bench_multi: runtime died at config {name!r}: "
+                          f"{exc}")
+                    return 4
+                append_line(args.out, {
+                    "config": name,
+                    "error": f"config_error: {type(exc).__name__}: {exc}",
+                })
+                print(f"bench_multi: deterministic failure in {name!r}: "
+                      f"{exc}")
+                continue
+            dog.cancel()
+            append_line(args.out, {"config": name, **result})
+            print(json.dumps({"config": name, **result}))
+            sys.stdout.flush()
+    finally:
+        for k in (*_CONFIG_ENV_KEYS, "BENCH_WATCHDOG_SECS"):
+            os.environ.pop(k, None)
+
+    state = load_state(args.out)
+    unresolved = [n for n, _, _ in CONFIGS
+                  if state.get(n) in (None, "innocent")]
+    return 1 if unresolved else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Round-5 window-3+ measurement program: the remaining A/B set via
+# tools/bench_multi.py — ONE process per invocation, safe compile
+# classes first, the two wedge-suspect compiles (Pallas fused loss,
+# 9-tap wgrad) last, per-config watchdogs, resume + poison-marking in
+# the JSONL artifact. Replaces tpu_perf_program2.sh's
+# one-process-per-leg structure after both chip windows this round died
+# during a fresh heavy compile in a new process (see bench_multi.py's
+# module docstring for the evidence).
+#
+# Retry contract with tools/tpu_watch.py: exits 0 only when EVERY
+# config is terminally resolved (measured / poisoned / deterministic
+# failure) — otherwise the watcher re-fires on a later healthy window
+# and bench_multi resumes, spending chip time only on innocent
+# unmeasured configs.
+#
+# Channel discipline: ONE TPU client at a time — stop tools/tpu_watch.py
+# before running this by hand.
+#
+#   bash tools/tpu_perf_program3.sh [outdir]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+OUT="${1:-.perf_r05}"
+mkdir -p "$OUT"
+OUT="$(cd "$OUT" && pwd)"
+
+echo "== pre-flight health probe"
+if ! python tools/tpu_health.py --timeout 300 --out "$OUT/health_pre3.json"; then
+    echo "runtime unhealthy — aborting (see $OUT/health_pre3.json)"
+    exit 1
+fi
+
+# Re-invoke until all configs resolve (rc=0), the runtime dies
+# (rc=2/4 — give the window back to the watcher), or the bounded loop
+# runs out. rc=3 means a config watchdogged and was poison-marked: the
+# next invocation (after a liveness probe) continues with the rest.
+RC=1
+for attempt in 1 2 3 4 5 6; do
+    echo "== bench_multi invocation $attempt"
+    # Belt-and-suspenders only: every config self-bounds via its own
+    # watchdog (sum of budgets 9900s + probes), so this outer timeout
+    # must exceed that worst case — a SIGTERM here is indistinguishable
+    # from a wedge and would falsely poison-mark the running config.
+    timeout --signal=TERM 11000 \
+        python -u tools/bench_multi.py --out "$OUT/bench_multi.jsonl"
+    RC=$?
+    case $RC in
+        0) echo "all configs terminally resolved"; break ;;
+        3) echo "config watchdogged (poison-marked); continuing" ;;
+        2|4) echo "runtime dead (rc=$RC); returning window to watcher"; break ;;
+        *) echo "unexpected rc=$RC; stopping"; break ;;
+    esac
+done
+
+echo "== post-run health probe"
+python tools/tpu_health.py --timeout 300 --out "$OUT/health_post3.json" || true
+cp "$OUT/health_post3.json" TPU_HEALTH.json
+echo "done (rc=$RC) — artifacts in $OUT/"
+exit $RC
