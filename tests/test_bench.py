@@ -91,3 +91,49 @@ def test_poll_ledger_summary(tmp_path):
     }
     missing = bench._poll_ledger_summary(path=str(tmp_path / "nope.jsonl"))
     assert missing["available"] is False
+
+
+def test_session_measurement_prefers_headline_and_stamps(tmp_path):
+    """A dead round-end capture must carry the watcher-fired measurement
+    in-band (the 0.0 error line alone would read as 'no number this
+    round' — rounds 1-4's failure mode). Only headline-config rows
+    compete; error rows, A/B-config rows, and torn concurrent-append
+    lines (truncated, non-dict, non-numeric value) are all skipped."""
+    default = tmp_path / "bench_default.json"
+    default.write_text(json.dumps(
+        {"metric": "unet_train_imgs_per_sec_b4_640x960_tpu",
+         "value": 37.08, "unit": "imgs/sec"}) + "\n")
+    multi = tmp_path / "bench_multi.jsonl"
+    multi.write_text("\n".join([
+        json.dumps({"event": "attempting", "config": "pixel"}),
+        json.dumps({"config": "pixel", "value": 99.0}),      # A/B row
+        json.dumps({"config": "default", "value": 37.5}),    # headline
+        json.dumps({"config": "b8", "error": "watchdog: x", "value": 0.0}),
+        "{truncated",
+        "0",                                    # valid JSON, not a dict
+        json.dumps({"config": "default", "value": "99.9"}),  # torn value
+    ]) + "\n")
+    got = bench._session_measurement(paths=(str(default), str(multi)))
+    assert got["value"] == 37.5  # best successful headline row wins
+    assert got["artifact"] == str(multi)
+    assert isinstance(got["artifact_mtime"], int)
+
+
+def test_session_measurement_absent(tmp_path):
+    assert bench._session_measurement(
+        paths=(str(tmp_path / "nope.json"),)) is None
+
+
+def test_failure_evidence_never_raises(monkeypatch):
+    """The evidence fields ride inside the watchdog timer thread and the
+    last-resort except block — an exception THERE would produce an empty
+    artifact, the exact outcome the watchdog exists to prevent."""
+    evidence = bench._failure_evidence()
+    assert "poll_ledger" in evidence and "session_measurement" in evidence
+
+    def boom():
+        raise KeyError("ts")
+
+    monkeypatch.setattr(bench, "_poll_ledger_summary", boom)
+    evidence = bench._failure_evidence()
+    assert evidence == {"evidence_error": "KeyError: 'ts'"}
