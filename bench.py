@@ -166,6 +166,77 @@ print(json.dumps({
 """
 
 
+# -- cooperative single-client lock ------------------------------------------
+# The tunneled runtime tolerates ONE client at a time; the two foreseeable
+# colliders are the standing watcher's probes (tools/tpu_watch.py) and the
+# driver's round-end `python bench.py` capture. This advisory lockfile lets
+# them take turns: the watcher holds it around each probe, the capture waits
+# (bounded) for a probe in flight to finish instead of dialing alongside it.
+# Best-effort by design — a SIGKILLed holder leaves a stale file, which the
+# next acquirer detects (dead pid) and removes; it is collision AVOIDANCE
+# for minutes-long overlaps, not a correctness mutex.
+_CLIENT_LOCK_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".tpu_client.lock")
+
+
+def _client_lock_holder() -> dict | None:
+    """The live holder of the client lock, or None (absent/stale/torn)."""
+    try:
+        with open(_CLIENT_LOCK_PATH) as f:
+            d = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    if not isinstance(d, dict) or not isinstance(d.get("pid"), int):
+        return None
+    try:
+        os.kill(d["pid"], 0)
+    except ProcessLookupError:
+        return None  # holder died without releasing — stale
+    except PermissionError:
+        pass
+    return d
+
+
+def acquire_client_lock(tag: str, wait_secs: float = 0.0,
+                        poll_secs: float = 10.0) -> bool:
+    """Try to take the single-client lock, waiting up to wait_secs for a
+    live holder to release. Returns False if still held at timeout."""
+    deadline = time.monotonic() + wait_secs
+    while True:
+        try:
+            fd = os.open(_CLIENT_LOCK_PATH,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            holder = _client_lock_holder()
+            if holder is None:
+                # stale or torn — remove and retry immediately
+                try:
+                    os.remove(_CLIENT_LOCK_PATH)
+                except OSError:
+                    pass
+                continue
+            if holder.get("pid") == os.getpid():
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(min(poll_secs,
+                           max(0.1, deadline - time.monotonic())))
+            continue
+        with os.fdopen(fd, "w") as f:
+            json.dump({"pid": os.getpid(), "tag": tag,
+                       "ts": time.time()}, f)
+        return True
+
+
+def release_client_lock() -> None:
+    holder = _client_lock_holder()
+    if holder is not None and holder.get("pid") == os.getpid():
+        try:
+            os.remove(_CLIENT_LOCK_PATH)
+        except OSError:
+            pass
+
+
 def _probe_once(timeout: float) -> dict:
     """One health probe in a fresh subprocess, bounded by `timeout`.
 
@@ -569,6 +640,20 @@ def main():
     watchdog_secs = float(os.environ.get("BENCH_WATCHDOG_SECS", 900))
     _arm_watchdog(watchdog_secs)
     t0 = time.monotonic()
+
+    # Take (or wait for) the single-client lock: if the standing
+    # watcher has a probe in flight, dialing alongside it is the
+    # two-client wedge. Bounded — a capture must degrade to "proceed
+    # and hope" rather than never run; a stale lock (dead holder) is
+    # reclaimed inside acquire_client_lock.
+    if not acquire_client_lock(
+            "bench-capture", wait_secs=min(300.0, 0.3 * watchdog_secs)):
+        print("bench: client lock still held after wait "
+              f"({_client_lock_holder()}); proceeding anyway",
+              file=sys.stderr)
+    import atexit
+
+    atexit.register(release_client_lock)
 
     # Pre-flight (skippable for CPU-only dev runs where dialing a TPU is
     # not even attempted): prove the runtime answers a trivial computation

@@ -137,3 +137,54 @@ def test_failure_evidence_never_raises(monkeypatch):
     monkeypatch.setattr(bench, "_poll_ledger_summary", boom)
     evidence = bench._failure_evidence()
     assert evidence == {"evidence_error": "KeyError: 'ts'"}
+
+
+class TestClientLock:
+    """The advisory single-client lock that keeps the watcher's probes
+    and the driver's round-end capture from dialing the tunneled
+    runtime concurrently (the two-client wedge)."""
+
+    @staticmethod
+    def _use_tmp_lock(monkeypatch, tmp_path):
+        monkeypatch.setattr(
+            bench, "_CLIENT_LOCK_PATH", str(tmp_path / "client.lock"))
+
+    def test_acquire_release_cycle(self, tmp_path, monkeypatch):
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        assert bench.acquire_client_lock("a") is True
+        holder = bench._client_lock_holder()
+        assert holder["pid"] == bench.os.getpid()
+        assert holder["tag"] == "a"
+        # re-entrant for the same pid
+        assert bench.acquire_client_lock("a") is True
+        bench.release_client_lock()
+        assert bench._client_lock_holder() is None
+
+    def test_live_foreign_holder_blocks_then_timeout(
+            self, tmp_path, monkeypatch):
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        # a LIVE foreign holder (pid 1 always exists)
+        (tmp_path / "client.lock").write_text(
+            json.dumps({"pid": 1, "tag": "other", "ts": 0}))
+        t0 = time.monotonic()
+        assert bench.acquire_client_lock(
+            "b", wait_secs=0.3, poll_secs=0.1) is False
+        assert time.monotonic() - t0 >= 0.25
+        # and release by a non-holder must NOT remove the lock
+        bench.release_client_lock()
+        assert bench._client_lock_holder()["pid"] == 1
+
+    def test_stale_lock_reclaimed(self, tmp_path, monkeypatch):
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        # a dead holder: pick a pid that cannot exist
+        (tmp_path / "client.lock").write_text(
+            json.dumps({"pid": 2 ** 22 + 1234, "tag": "dead", "ts": 0}))
+        assert bench.acquire_client_lock("c") is True
+        assert bench._client_lock_holder()["tag"] == "c"
+        bench.release_client_lock()
+
+    def test_torn_lockfile_reclaimed(self, tmp_path, monkeypatch):
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        (tmp_path / "client.lock").write_text("{torn")
+        assert bench.acquire_client_lock("d") is True
+        bench.release_client_lock()

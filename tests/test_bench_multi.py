@@ -113,6 +113,8 @@ class TestMainLoop:
 
         # main() imports bench lazily; plant the fake in sys.modules
         fake_mod._probe_once = probe
+        fake_mod.acquire_client_lock = lambda *a, **k: True
+        fake_mod.release_client_lock = lambda: None
         monkeypatch.setitem(sys.modules, "bench", fake_mod)
 
     def test_all_configs_measured(self, tmp_path, monkeypatch):

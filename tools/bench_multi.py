@@ -182,7 +182,22 @@ def main(argv=None) -> int:
               f"resolved in {args.out}")
         return 0
 
-    from bench import _probe_once  # SIGTERM-only subprocess probe
+    from bench import (  # SIGTERM-only subprocess probe + client lock
+        _probe_once,
+        acquire_client_lock,
+        release_client_lock,
+    )
+
+    # Mark single-client occupancy for the whole program (a hand-run
+    # bench_multi alongside a polling watcher is the two-client wedge;
+    # the lock makes the watcher hold off instead).
+    import atexit
+
+    if not acquire_client_lock("bench_multi", wait_secs=120.0):
+        print("bench_multi: client lock held; refusing to dial alongside "
+              "another TPU client")
+        return 2
+    atexit.register(release_client_lock)
 
     probe = _probe_once(args.probe_timeout)
     append_line(args.out, {"event": "session_start", "probe": probe,
