@@ -177,17 +177,37 @@ print(json.dumps({
 # for minutes-long overlaps, not a correctness mutex.
 _CLIENT_LOCK_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".tpu_client.lock")
+# Longest legitimate hold: bench_multi keeps the lock for its whole
+# program (worst case ~2.75 h of per-config watchdog budgets). Beyond
+# this age a lock is stale regardless of pid liveness — pid-existence
+# alone cannot distinguish a live holder from a recycled pid (reboot,
+# wraparound), which would otherwise hold the watcher off forever.
+_CLIENT_LOCK_MAX_AGE_S = 4.0 * 3600.0
+
+
+def _read_lock_raw() -> bytes | None:
+    try:
+        with open(_CLIENT_LOCK_PATH, "rb") as f:
+            return f.read()
+    except OSError:
+        return None
 
 
 def _client_lock_holder() -> dict | None:
     """The live holder of the client lock, or None (absent/stale/torn)."""
+    raw = _read_lock_raw()
+    if raw is None:
+        return None
     try:
-        with open(_CLIENT_LOCK_PATH) as f:
-            d = json.loads(f.read())
-    except (OSError, ValueError):
+        d = json.loads(raw)
+    except ValueError:
         return None
     if not isinstance(d, dict) or not isinstance(d.get("pid"), int):
         return None
+    ts = d.get("ts")
+    if not isinstance(ts, (int, float)) \
+            or time.time() - ts > _CLIENT_LOCK_MAX_AGE_S:
+        return None  # older than any legitimate hold — stale
     try:
         os.kill(d["pid"], 0)
     except ProcessLookupError:
@@ -207,13 +227,34 @@ def acquire_client_lock(tag: str, wait_secs: float = 0.0,
             fd = os.open(_CLIENT_LOCK_PATH,
                          os.O_CREAT | os.O_EXCL | os.O_WRONLY)
         except FileExistsError:
+            stale_raw = _read_lock_raw()
             holder = _client_lock_holder()
             if holder is None:
-                # stale or torn — remove and retry immediately
-                try:
-                    os.remove(_CLIENT_LOCK_PATH)
-                except OSError:
-                    pass
+                # Stale or torn. Remove ONLY if the file still holds the
+                # content we judged stale — a rival waiter may have
+                # reclaimed and written ITS lock in between, and blindly
+                # removing that would let two clients through (the
+                # reclaim TOCTOU). After a successful remove, retry the
+                # O_EXCL create immediately (a zero-wait caller must
+                # still win a reclaim): exactly one racer wins; the
+                # loser sees the winner as a live holder next pass.
+                if stale_raw is not None \
+                        and _read_lock_raw() == stale_raw:
+                    try:
+                        os.remove(_CLIENT_LOCK_PATH)
+                    except OSError:
+                        pass
+                    else:
+                        continue
+                elif stale_raw is None \
+                        and not os.path.lexists(_CLIENT_LOCK_PATH):
+                    continue  # vanished between create and read — retry
+                # an unremovable path (directory, permissions) must not
+                # spin at 100% CPU forever: honor the same deadline and
+                # pacing as the live-holder branch
+                if time.monotonic() >= deadline:
+                    return False
+                time.sleep(min(1.0, poll_secs))
                 continue
             if holder.get("pid") == os.getpid():
                 return True
@@ -235,6 +276,17 @@ def release_client_lock() -> None:
             os.remove(_CLIENT_LOCK_PATH)
         except OSError:
             pass
+
+
+def transfer_client_lock(pid: int, tag: str) -> None:
+    """Re-point the lock we hold at another live process (the watcher's
+    orphaned probe child: the parent's lock must outlive the parent and
+    expire with the ORPHAN, or a bench capture would dial alongside
+    it). Caller must currently hold the lock."""
+    tmp = _CLIENT_LOCK_PATH + f".{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"pid": pid, "tag": tag, "ts": time.time()}, f)
+    os.replace(tmp, _CLIENT_LOCK_PATH)
 
 
 def _probe_once(timeout: float) -> dict:

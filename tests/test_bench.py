@@ -53,6 +53,11 @@ def test_preflight_respects_deadline(monkeypatch):
 
 
 def test_tpu_health_artifact(tmp_path, monkeypatch, capsys):
+    # don't couple the test to the REAL repo-anchored client lock (a
+    # concurrently-probing watcher would stall the 90 s bounded wait)
+    monkeypatch.setattr(tpu_health, "acquire_client_lock",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(tpu_health, "release_client_lock", lambda: None)
     monkeypatch.setattr(
         "sys.argv", ["tpu_health", "--out", str(tmp_path / "h.json"),
                      "--timeout", "120"],
@@ -163,9 +168,10 @@ class TestClientLock:
     def test_live_foreign_holder_blocks_then_timeout(
             self, tmp_path, monkeypatch):
         self._use_tmp_lock(monkeypatch, tmp_path)
-        # a LIVE foreign holder (pid 1 always exists)
+        # a LIVE foreign holder (pid 1 always exists; fresh ts — an
+        # ancient ts would be age-bounded stale and reclaimed)
         (tmp_path / "client.lock").write_text(
-            json.dumps({"pid": 1, "tag": "other", "ts": 0}))
+            json.dumps({"pid": 1, "tag": "other", "ts": time.time()}))
         t0 = time.monotonic()
         assert bench.acquire_client_lock(
             "b", wait_secs=0.3, poll_secs=0.1) is False
@@ -188,3 +194,31 @@ class TestClientLock:
         (tmp_path / "client.lock").write_text("{torn")
         assert bench.acquire_client_lock("d") is True
         bench.release_client_lock()
+
+
+    def test_aged_out_live_holder_is_stale(self, tmp_path, monkeypatch):
+        """Pid-existence alone cannot distinguish a live holder from a
+        recycled pid; a lock older than any legitimate hold is reclaimed
+        even if its pid maps to a running process."""
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        (tmp_path / "client.lock").write_text(json.dumps(
+            {"pid": 1, "tag": "ancient",
+             "ts": time.time() - bench._CLIENT_LOCK_MAX_AGE_S - 60}))
+        assert bench._client_lock_holder() is None
+        assert bench.acquire_client_lock("fresh") is True
+        bench.release_client_lock()
+
+    def test_transfer_lock_repoints_holder(self, tmp_path, monkeypatch):
+        """The watcher re-points its lock at an orphaned probe child so
+        the lock expires with the ORPHAN (pid-liveness), not with the
+        watcher's probe round."""
+        self._use_tmp_lock(monkeypatch, tmp_path)
+        assert bench.acquire_client_lock("watcher-probe") is True
+        bench.transfer_client_lock(1, "orphan-probe")  # pid 1: alive
+        holder = bench._client_lock_holder()
+        assert holder == {"pid": 1, "tag": "orphan-probe",
+                          "ts": holder["ts"]}
+        # no longer ours to release
+        bench.release_client_lock()
+        assert bench._client_lock_holder()["pid"] == 1
+        (tmp_path / "client.lock").unlink()

@@ -25,6 +25,8 @@ def _no_real_environment_coupling(monkeypatch):
     monkeypatch.setattr(tpu_watch, "acquire_client_lock",
                         lambda *a, **k: True)
     monkeypatch.setattr(tpu_watch, "release_client_lock", lambda: None)
+    monkeypatch.setattr(tpu_watch, "transfer_client_lock",
+                        lambda *a, **k: None)
 
 
 def _read(path):
@@ -211,3 +213,36 @@ class TestForeignClientHoldoff:
         assert len(probes) >= 1
         first_probe = events.index("probe")
         assert events[:first_probe].count("holdoff_foreign_client") == 2
+
+
+def test_orphan_probe_inherits_the_client_lock(tmp_path, monkeypatch):
+    """A probe child that ignored SIGTERM is still a live client on the
+    runtime: the watcher must re-point the lock at the ORPHAN's pid
+    (not release it) so a driver capture waits the orphan out instead
+    of dialing alongside it."""
+    ledger = tmp_path / "poll.jsonl"
+    transfers, releases = [], []
+    monkeypatch.setattr(
+        tpu_watch, "_probe_once",
+        lambda t: {"ok": False,
+                   "error": "probe hung 1s, ignored SIGTERM "
+                            "(left running, pid 777)"})
+    monkeypatch.setattr(tpu_watch, "_pid_alive", lambda pid: True)
+    monkeypatch.setattr(
+        tpu_watch, "transfer_client_lock",
+        lambda pid, tag: transfers.append((pid, tag)))
+    monkeypatch.setattr(
+        tpu_watch, "release_client_lock",
+        lambda: releases.append(1))
+    monkeypatch.setattr(tpu_watch.time, "sleep", lambda s: None)
+    clock = itertools.count()
+    monkeypatch.setattr(
+        tpu_watch.time, "monotonic", lambda: float(next(clock)))
+    monkeypatch.setattr(
+        sys, "argv",
+        ["tpu_watch.py", "--ledger", str(ledger), "--interval", "1",
+         "--probe-timeout", "1", "--max-hours", str(30 / 3600.0),
+         "--perf-out", str(tmp_path / "perf")])
+    assert tpu_watch.main() == 0
+    assert transfers == [(777, "orphan-probe")]
+    assert releases == []  # never released while the orphan lives

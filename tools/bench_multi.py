@@ -65,6 +65,11 @@ CONFIGS = [
      {"BENCH_ARCH": "milesial", "BENCH_S2D_LEVELS": "0"}, 1500.0),
     ("pallas_loss", {"BENCH_PALLAS_LOSS": "1"}, 1500.0),
     ("wgrad_taps", {"BENCH_WGRAD_TAPS": "1"}, 2700.0),
+    # the taps path with the single-pass Pallas wgrad kernel
+    # (ops/wgrad_pallas.py) on channels>=64 taps: Mosaic compile on top
+    # of the big taps graph — the most dangerous compile, dead last
+    ("wgrad_taps_pallas",
+     {"BENCH_WGRAD_TAPS": "1", "DPT_WGRAD_BACKEND": "pallas"}, 2700.0),
 ]
 
 # Every env key any config may set — popped between configs so a lever

@@ -25,7 +25,11 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import _probe_once  # noqa: E402  (SIGTERM-only subprocess probe)
+from bench import (  # noqa: E402  (SIGTERM-only subprocess probe + lock)
+    _probe_once,
+    acquire_client_lock,
+    release_client_lock,
+)
 
 
 def _suspect_processes() -> list:
@@ -71,7 +75,17 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=240.0)
     args = ap.parse_args()
 
-    result = _probe_once(args.timeout)
+    # Single-client discipline (shared with bench.py / tpu_watch.py): a
+    # hand-run health check alongside a polling watcher is two clients.
+    # Bounded wait, then probe anyway — a health check must never be
+    # silently skipped; the artifact is the round's hygiene record.
+    if not acquire_client_lock("tpu-health", wait_secs=90.0):
+        print("tpu_health: client lock held; probing anyway after wait",
+              file=sys.stderr)
+    try:
+        result = _probe_once(args.timeout)
+    finally:
+        release_client_lock()
     artifact = {
         "checked_at": datetime.datetime.now(datetime.timezone.utc).isoformat(
             timespec="seconds"

@@ -50,7 +50,17 @@ from bench import (  # noqa: E402  (SIGTERM-only subprocess probe + lock)
     _probe_once,
     acquire_client_lock,
     release_client_lock,
+    transfer_client_lock,
 )
+
+
+def _sleep_or_stop(secs: float, deadline: float) -> bool:
+    """Sleep `secs` unless that would cross the deadline; False = stop.
+    The one holdoff/pacing primitive for the whole main loop."""
+    if time.monotonic() + secs >= deadline:
+        return False
+    time.sleep(secs)
+    return True
 
 # bench._probe_once's hung-probe contract: the child ignored SIGTERM and
 # was LEFT RUNNING (killing it harder is what wedges the relay).
@@ -205,9 +215,8 @@ def main() -> int:
             if _pid_alive(orphan_pid):
                 append_ledger(args.ledger, {
                     "event": "waiting_orphan_probe", "pid": orphan_pid})
-                if time.monotonic() + args.interval >= deadline:
+                if not _sleep_or_stop(args.interval, deadline):
                     break
-                time.sleep(args.interval)
                 continue
             append_ledger(args.ledger, {
                 "event": "orphan_probe_exited", "pid": orphan_pid})
@@ -225,22 +234,30 @@ def main() -> int:
             append_ledger(args.ledger, {
                 "event": "holdoff_foreign_client",
                 "cmdline": foreign or "client lock held"})
-            if time.monotonic() + 60.0 >= deadline:
+            if not _sleep_or_stop(60.0, deadline):
                 break
-            time.sleep(60.0)
             continue
         attempt += 1
         t0 = time.monotonic()
         try:
             result = _probe_once(args.probe_timeout)
-        finally:
+        except BaseException:
+            release_client_lock()
+            raise
+        m = _ORPHAN_RE.search(result.get("error", "") or "")
+        if m:
+            # The orphan child is still a live client on the runtime:
+            # the lock must expire with IT, not with our probe round —
+            # re-point the lock at the orphan's pid so a bench capture
+            # waits it out (even across a watcher restart) instead of
+            # dialing alongside it.
+            orphan_pid = int(m.group(1))
+            transfer_client_lock(orphan_pid, "orphan-probe")
+        else:
             release_client_lock()
         record = {"event": "probe", "attempt": attempt,
                   "elapsed_s": round(time.monotonic() - t0, 1), **result}
         append_ledger(args.ledger, record)
-        m = _ORPHAN_RE.search(result.get("error", "") or "")
-        if m:
-            orphan_pid = int(m.group(1))
         if result.get("ok") and not fired and _foreign_client_running():
             # a driver capture started while our probe ran — let it own
             # the healthy window, then re-check on the prompt 60 s
@@ -249,9 +266,8 @@ def main() -> int:
             # deadline)
             append_ledger(args.ledger, {
                 "event": "holdoff_foreign_client_at_fire"})
-            if time.monotonic() + 60.0 >= deadline:
+            if not _sleep_or_stop(60.0, deadline):
                 break
-            time.sleep(60.0)
             continue
         if result.get("ok") and not fired:
             os.makedirs(args.perf_out, exist_ok=True)
@@ -276,10 +292,9 @@ def main() -> int:
                                         "rc": rc,
                                         "fire_attempts": fire_attempts,
                                         "outdir": args.perf_out})
-        sleep_s = args.post_interval if fired else args.interval
-        if time.monotonic() + sleep_s >= deadline:
+        if not _sleep_or_stop(
+                args.post_interval if fired else args.interval, deadline):
             break
-        time.sleep(sleep_s)
     append_ledger(args.ledger, {"event": "watcher_stop", "attempts": attempt,
                                 "fired": fired})
     return 0
