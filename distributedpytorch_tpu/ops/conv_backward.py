@@ -52,9 +52,42 @@ def _wgrad_backend() -> str:
 
 
 @jax.custom_vjp
-def conv3x3_same_taps(x: jax.Array, kernel: jax.Array) -> jax.Array:
+def _conv3x3_same_taps_vjp(x: jax.Array, kernel: jax.Array) -> jax.Array:
     """NHWC SAME stride-1 3×3 conv; forward = XLA conv, backward =
     XLA conv for dx + 9 tap matmuls for dW."""
+    return _conv_same(x, kernel)
+
+
+def _taps_min_hw() -> int:
+    """Trace-time spatial gate for the taps rewrite.
+
+    ``DPT_WGRAD_TAPS_MIN_HW=N`` scopes the 9-tap weight gradient to
+    convs whose H·W plane is at least N pixels (default 0 = every
+    conv). Two reasons to scope: (a) the tall-contraction win
+    concentrates where K = B·H·W is largest — the shallow levels —
+    while small-plane convs gain nothing over XLA's emitter; (b) the
+    full-taps graph (9 einsums × every conv) is the largest XLA program
+    this framework emits, and the round-5 window-1 attempt never
+    finished compiling it over the tunneled runtime in 1200 s — scoping
+    to the top level(s) shrinks the graph severalfold."""
+    raw = os.environ.get("DPT_WGRAD_TAPS_MIN_HW", "0")
+    try:
+        return int(raw)
+    except ValueError:
+        # fail LOUD: a typo'd threshold silently falling back to 0 would
+        # select the full-taps-everywhere graph — the exact compile hang
+        # the scoped config exists to avoid — under the scoped label
+        raise ValueError(
+            f"DPT_WGRAD_TAPS_MIN_HW={raw!r}: expected an integer pixel "
+            "count") from None
+
+
+def conv3x3_same_taps(x: jax.Array, kernel: jax.Array) -> jax.Array:
+    """The public taps conv: every model call site funnels here, so the
+    DPT_WGRAD_TAPS_MIN_HW gate applies uniformly. Below the gate the
+    conv is the plain XLA one — identical forward AND backward."""
+    if x.shape[1] * x.shape[2] >= _taps_min_hw():
+        return _conv3x3_same_taps_vjp(x, kernel)
     return _conv_same(x, kernel)
 
 
@@ -103,4 +136,4 @@ def _bwd(res, dy):
     return dx.astype(x.dtype), dk.astype(kernel.dtype)
 
 
-conv3x3_same_taps.defvjp(_fwd, _bwd)
+_conv3x3_same_taps_vjp.defvjp(_fwd, _bwd)

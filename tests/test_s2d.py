@@ -424,3 +424,63 @@ class TestWgradTaps:
                 )
 
         check()
+
+
+class TestWgradTapsSpatialGate:
+    """DPT_WGRAD_TAPS_MIN_HW scopes the taps rewrite to convs whose
+    H·W plane is at least the threshold — the sub-gate convs must run
+    the PLAIN conv path (identical numerics either way; what changes is
+    which backward XLA compiles, and the graph size)."""
+
+    def test_gate_routes_by_plane_size(self, monkeypatch):
+        from distributedpytorch_tpu.ops import conv_backward as cb
+
+        calls = []
+        real = cb._conv3x3_same_taps_vjp
+        monkeypatch.setattr(
+            cb, "_conv3x3_same_taps_vjp",
+            lambda x, k: calls.append(x.shape) or real(x, k))
+        rng = np.random.default_rng(0)
+        big = jnp.asarray(rng.random((1, 24, 24, 4), dtype=np.float32))
+        small = jnp.asarray(rng.random((1, 8, 8, 4), dtype=np.float32))
+        k = jnp.asarray(rng.random((3, 3, 4, 4), dtype=np.float32))
+
+        monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", "200")
+        cb.conv3x3_same_taps(big, k)    # 576 px >= 200 -> taps
+        cb.conv3x3_same_taps(small, k)  # 64 px < 200 -> plain conv
+        assert calls == [(1, 24, 24, 4)]
+
+        # unset = everywhere; garbage must fail LOUD (a silent fallback
+        # to 0 would select the full-taps graph under a scoped label)
+        monkeypatch.delenv("DPT_WGRAD_TAPS_MIN_HW")
+        cb.conv3x3_same_taps(small, k)
+        assert len(calls) == 2
+        monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", "not-a-number")
+        with pytest.raises(ValueError, match="DPT_WGRAD_TAPS_MIN_HW"):
+            cb.conv3x3_same_taps(small, k)
+
+    def test_gated_numerics_identical(self, monkeypatch):
+        """Grads through the gated function equal the plain conv's grads
+        regardless of which side of the gate a conv falls on."""
+        from distributedpytorch_tpu.ops.conv_backward import (
+            conv3x3_same_taps,
+        )
+        from distributedpytorch_tpu.ops.s2d import conv_same
+
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((2, 10, 14, 8), dtype=np.float32))
+        k = jnp.asarray(rng.standard_normal((3, 3, 8, 8), dtype=np.float32))
+        dy = jnp.asarray(rng.standard_normal((2, 10, 14, 8), dtype=np.float32))
+        ref = jax.grad(lambda x, k: jnp.sum(conv_same(x, k) * dy),
+                       argnums=(0, 1))(x, k)
+        for thresh in ("0", "1000000"):  # taps side / plain side
+            monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", thresh)
+            got = jax.grad(
+                lambda x, k: jnp.sum(conv3x3_same_taps(x, k) * dy),
+                argnums=(0, 1))(x, k)
+            np.testing.assert_allclose(np.asarray(got[0]),
+                                       np.asarray(ref[0]),
+                                       rtol=1e-5, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(got[1]),
+                                       np.asarray(ref[1]),
+                                       rtol=1e-5, atol=1e-4)

@@ -64,6 +64,12 @@ CONFIGS = [
     ("milesial_pixel",
      {"BENCH_ARCH": "milesial", "BENCH_S2D_LEVELS": "0"}, 1500.0),
     ("pallas_loss", {"BENCH_PALLAS_LOSS": "1"}, 1500.0),
+    # taps scoped to the top s2d level only (320x480 planes = 153600 px;
+    # the next level down is 38400): where the tall-contraction win
+    # concentrates, at a severalfold smaller XLA graph than full taps —
+    # the fallback if window-1's full-taps compile failure repeats
+    ("wgrad_taps_l1",
+     {"BENCH_WGRAD_TAPS": "1", "DPT_WGRAD_TAPS_MIN_HW": "100000"}, 1500.0),
     ("wgrad_taps", {"BENCH_WGRAD_TAPS": "1"}, 2700.0),
     # the taps path with the single-pass Pallas wgrad kernel
     # (ops/wgrad_pallas.py) on channels>=64 taps: Mosaic compile on top
