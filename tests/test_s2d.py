@@ -327,6 +327,14 @@ class TestWgradTaps:
     """The 9-tap-matmul conv backward (ops/conv_backward.py) must be a
     drop-in for XLA's conv autodiff: same forward, same dx, same dW."""
 
+    @pytest.fixture(autouse=True)
+    def _taps_everywhere(self, monkeypatch):
+        # Pin the spatial gate open: these tiny test planes would fall
+        # below an ambient DPT_WGRAD_TAPS_MIN_HW (e.g. exported while
+        # iterating on the scoped bench config), silently degenerating
+        # every assertion into plain-conv-vs-itself.
+        monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", "0")
+
     def test_grads_match_xla(self):
         from distributedpytorch_tpu.ops.conv_backward import conv3x3_same_taps
         from distributedpytorch_tpu.ops.s2d import conv_same

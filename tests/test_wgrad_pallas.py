@@ -74,6 +74,7 @@ def test_backend_env_selects_pallas(monkeypatch):
     monkeypatch.setattr(
         wp, "wgrad_9tap_pallas",
         lambda *a, **kw: calls.append(1) or real(*a, **kw))
+    monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", "0")
     monkeypatch.setenv("DPT_WGRAD_BACKEND", "pallas")
     b, h, w, c = 1, 3, 4, 128
     x = _rand((b, h, w, c), 5)
@@ -96,6 +97,7 @@ def test_backend_env_selects_pallas(monkeypatch):
 def test_backend_env_skips_pallas_for_skinny_channels(monkeypatch):
     """Channels below the lane width stay on einsum even when the env
     asks for pallas (grad must still be exact)."""
+    monkeypatch.setenv("DPT_WGRAD_TAPS_MIN_HW", "0")
     monkeypatch.setenv("DPT_WGRAD_BACKEND", "pallas")
     b, h, w = 1, 4, 4
     x = _rand((b, h, w, 3), 7)

@@ -54,9 +54,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # (name, env overrides, per-config watchdog seconds). Order is the
 # safety story (see module docstring): pixel's compile class already
 # succeeded on this channel in round 3, b8 is the default graph at a
-# bigger batch, the milesial pair is plain XLA convs, and the two
-# wedge-suspects — the Pallas fused loss (killed window 2) and the
-# 9-tap wgrad graph (killed window 1) — go last, taps very last.
+# bigger batch, the milesial pair is plain XLA convs, and the
+# wedge-suspect compiles go last in INCREASING danger: the Pallas fused
+# loss (killed window 2), then the taps family in increasing graph size
+# — scoped-to-level-1 taps, full taps (killed window 1 mid-compile),
+# and finally full taps with the Mosaic wgrad kernel on top.
 CONFIGS = [
     ("pixel", {"BENCH_S2D_LEVELS": "0"}, 1200.0),
     ("b8", {"BENCH_BATCH": "8"}, 1200.0),

@@ -46,6 +46,11 @@ def chain_time(fn, args, n):
 
 
 def main():
+    # This tool MEASURES the taps path: pin the spatial gate open so an
+    # ambient DPT_WGRAD_TAPS_MIN_HW (e.g. exported while iterating on
+    # the scoped bench config) can't silently reroute the taps rows to
+    # the plain conv under a taps label.
+    os.environ["DPT_WGRAD_TAPS_MIN_HW"] = "0"
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--full-step", action="store_true",
