@@ -47,7 +47,10 @@ fabricated; carried in-band as ``baseline_source: "estimate"`` with
 ``vs_baseline_vs_low`` alongside the central ``vs_baseline``.
 
 Exit codes: 0 = measured number; 2 = preflight never reached a live
-runtime (JSON carries the staged probe history); 3 = watchdog fired
+runtime (JSON carries the staged probe history — and when a same-session
+watcher-fired measurement exists, it is PROMOTED to the top-level
+metric/value with ``provenance: "watcher_session"`` so the channel never
+reports 0.0 for a round that actually measured); 3 = watchdog fired
 mid-run. The JSON line is emitted in every case.
 """
 
@@ -503,14 +506,21 @@ def run() -> dict:
 
         loss_impl = fused_bce_dice_loss
 
+    # per-phase host-span tracer (utils/trace.py): the same decode/stack/
+    # h2d/dispatch/readback phases the trainer's --trace-timeline records,
+    # measured inline here so every bench row carries an attribution
+    # breakdown next to its imgs/sec (in-memory; summarized at the end)
+    from distributedpytorch_tpu.utils.trace import StepTimeline
+
+    timeline = StepTimeline(enabled=True)
+
     rng = np.random.default_rng(0)
     dev = jax.devices()[0]
-    batch = {
-        "image": jax.device_put(rng.random((BATCH, H, W, 3), dtype=np.float32), dev),
-        "mask": jax.device_put(
-            (rng.random((BATCH, H, W)) > 0.5).astype(np.int32), dev
-        ),
+    host_batch = {
+        "image": rng.random((BATCH, H, W, 3), dtype=np.float32),
+        "mask": (rng.random((BATCH, H, W)) > 0.5).astype(np.int32),
     }
+    batch = {k: jax.device_put(v, dev) for k, v in host_batch.items()}
     # the fused executable scans over K stacked (identical) batches — what
     # the trainer dispatches under --steps-per-dispatch K
     stacked = {
@@ -578,10 +588,23 @@ def run() -> dict:
     float(loss)  # device→host transfer: a hard sync even over a PJRT relay
     # (block_until_ready alone does not force execution on tunneled devices)
 
+    # H2D phase: place the full host batch (what one pipeline payload
+    # costs), synced so the span covers the transfer, not just the enqueue
+    for _ in range(3):
+        with timeline.span("h2d"):
+            placed = {k: jax.device_put(v, dev) for k, v in host_batch.items()}
+            jax.block_until_ready(placed)
+    del placed
+
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, loss = compiled(state, batch)
-    float(loss)  # forces the whole dependency chain of donated states
+        # dispatch spans are the host-side enqueue cost; the final
+        # readback span absorbs the queued device time — together they
+        # bound where a throughput delta lives (host vs chip vs transfer)
+        with timeline.span("dispatch"):
+            state, loss = compiled(state, batch)
+    with timeline.span("readback"):
+        float(loss)  # forces the whole dependency chain of donated states
     dt_unfused = time.perf_counter() - t0
     unfused_per_step = dt_unfused / MEASURE_STEPS
 
@@ -607,6 +630,30 @@ def run() -> dict:
     per_step = min(fused_per_step, unfused_per_step)
     imgs_per_sec = BATCH / per_step
     peak = chip_peak_flops(dev)
+    # per-phase attribution: the inline spans above, plus (when
+    # BENCH_TIMELINE_JSONL names a trainer-written --trace-timeline file)
+    # the real end-to-end pipeline's phases including decode. The spans
+    # are recorded on the SINGLE-DISPATCH loop; when the fused K-step
+    # executable wins the headline, `headline_loop` flags that the phase
+    # timings come from a different executable (per-dispatch granularity
+    # differs), so a reader never attributes a fused-path delta to them.
+    timeline_summary = {
+        "source": "bench_inline",
+        "loop": "single_dispatch",
+        "headline_loop": (
+            "fused" if per_step == fused_per_step else "single_dispatch"
+        ),
+        **timeline.summary(),
+    }
+    trainer_jsonl = os.environ.get("BENCH_TIMELINE_JSONL")
+    timeline_trainer = None
+    if trainer_jsonl and os.path.exists(trainer_jsonl):
+        from distributedpytorch_tpu.utils.trace import summarize_timeline
+
+        timeline_trainer = {
+            "source": trainer_jsonl,
+            **summarize_timeline(trainer_jsonl),
+        }
     return {
         "metric": f"{arch}_train_imgs_per_sec_b{BATCH}_{H}x{W}_{dev.platform}",
         "value": round(imgs_per_sec, 2),
@@ -640,6 +687,49 @@ def run() -> dict:
             if peak > 0 and flops_executed is not None else None
         ),
         "device_kind": getattr(dev, "device_kind", dev.platform),
+        "timeline": timeline_summary,
+        "timeline_trainer": timeline_trainer,
+    }
+
+
+def _preflight_failure_payload(preflight_error: str, history: list) -> dict:
+    """The artifact line for a dead-at-capture runtime.
+
+    If the standing watcher landed a real same-session, same-code,
+    same-chip measurement earlier, promote it to the TOP-LEVEL
+    metric/value (VERDICT r05 item 2) instead of reporting 0.0 — the
+    preflight failure rides alongside, and ``provenance:
+    "watcher_session"`` marks the number as the watcher's, not this
+    capture's. Otherwise the classic 0.0 error line with the full
+    evidence block."""
+    session = None
+    try:
+        session = _session_measurement()
+    except Exception:  # noqa: BLE001 — promotion must not be fatal
+        pass
+    if session is not None:
+        return {
+            **{k: v for k, v in session.items()
+               if k not in ("artifact", "artifact_mtime")},
+            **_baseline_fields(float(session["value"])),
+            "provenance": "watcher_session",
+            "session_artifact": session.get("artifact"),
+            "session_artifact_mtime": session.get("artifact_mtime"),
+            "preflight_error": preflight_error,
+            "preflight_history": history,
+            "poll_ledger": _poll_ledger_summary(),
+        }
+    return {
+        "metric": f"{ARCH}_train_imgs_per_sec_b{BATCH}_{H}x{W}_preflight",
+        "value": 0.0,
+        "unit": "imgs/sec",
+        **_baseline_fields(0.0),
+        "error": preflight_error,
+        "preflight_history": history,
+        # the standing watcher's session-long evidence (VERDICT r04
+        # next-1: distinguishes "channel dead all round" from "not
+        # tried")
+        **_failure_evidence(),
     }
 
 
@@ -721,22 +811,13 @@ def main():
             "platform": history[-1].get("platform") if history else None,
         }
         if not ok:
-            print(json.dumps({
-                "metric": f"{ARCH}_train_imgs_per_sec_b{BATCH}_{H}x{W}_preflight",
-                "value": 0.0,
-                "unit": "imgs/sec",
-                **_baseline_fields(0.0),
-                "error": "preflight: runtime never answered a trivial "
-                         f"probe in {len(history)} staged attempts over "
-                         f"{time.monotonic() - t0:.0f}s",
-                "preflight_history": history,
-                # the standing watcher's session-long evidence (VERDICT
-                # r04 next-1: distinguishes "channel dead all round"
-                # from "not tried") plus the measurement that watcher
-                # DID land when the chip last answered this session, so
-                # a dead capture never erases a real same-session number
-                **_failure_evidence(),
-            }))
+            preflight_error = (
+                "preflight: runtime never answered a trivial "
+                f"probe in {len(history)} staged attempts over "
+                f"{time.monotonic() - t0:.0f}s"
+            )
+            print(json.dumps(
+                _preflight_failure_payload(preflight_error, history)))
             sys.stdout.flush()
             sys.exit(2)
 

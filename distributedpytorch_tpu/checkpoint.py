@@ -21,7 +21,10 @@ This module fixes that:
 
 from __future__ import annotations
 
+import itertools
 import os
+import threading
+from concurrent.futures import Future
 from typing import Any, Dict, Optional, Tuple
 
 import flax.serialization
@@ -38,8 +41,7 @@ def _to_host(tree):
     return jax.tree.map(np.asarray, jax.device_get(tree))
 
 
-def save_checkpoint(
-    path: str,
+def _build_payload(
     params,
     opt_state=None,
     scheduler_state: Optional[dict] = None,
@@ -48,8 +50,11 @@ def save_checkpoint(
     records_state: Optional[dict] = None,
     model_state=None,
     train_meta: Optional[dict] = None,
-) -> None:
-    payload = {
+) -> dict:
+    """Snapshot everything to HOST values. This is the only part of a save
+    that must run on the trainer thread: device buffers are donated into
+    the next dispatched step, so the device_get cannot be deferred."""
+    return {
         "version": CKPT_VERSION,
         # small scalar trainer state that must survive resume (best val
         # metrics for --save-best, early-stop patience counter) — plain
@@ -72,12 +77,116 @@ def save_checkpoint(
         if model_state is not None
         else None,
     }
+
+
+_TMP_COUNTER = itertools.count()
+
+
+def _write_payload(path: str, payload: dict) -> str:
+    """Serialize + atomic write (tmp + rename: a crash mid-write never
+    corrupts the previous checkpoint). Unique tmp names: queued async
+    saves of the same path must not clobber each other's tmp files."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     blob = flax.serialization.msgpack_serialize(payload)
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
+    return path
+
+
+def save_checkpoint(
+    path: str,
+    params,
+    opt_state=None,
+    scheduler_state: Optional[dict] = None,
+    step: int = 0,
+    epoch: int = 0,
+    records_state: Optional[dict] = None,
+    model_state=None,
+    train_meta: Optional[dict] = None,
+) -> None:
+    _write_payload(
+        path,
+        _build_payload(
+            params,
+            opt_state,
+            scheduler_state,
+            step,
+            epoch,
+            records_state,
+            model_state,
+            train_meta,
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Async saves: ONE background writer thread, saves applied in submission
+# order (so <tag>.ckpt always ends at the newest queued snapshot). The
+# thread is a daemon started on first use: serialization + disk I/O are the
+# multi-second part of a save (the device_get is not — see _build_payload)
+# and nothing in the step loop depends on them.
+# ---------------------------------------------------------------------------
+
+_writer_lock = threading.Lock()
+_writer_queue = None  # created lazily; holds (Future, path, payload)
+
+
+def _writer_loop(q):
+    while True:
+        fut, path, payload = q.get()
+        if not fut.set_running_or_notify_cancel():
+            continue
+        try:
+            fut.set_result(_write_payload(path, payload))
+        except BaseException as exc:  # surfaced via Future.result()
+            fut.set_exception(exc)
+
+
+def save_checkpoint_async(
+    path: str,
+    params,
+    opt_state=None,
+    scheduler_state: Optional[dict] = None,
+    step: int = 0,
+    epoch: int = 0,
+    records_state: Optional[dict] = None,
+    model_state=None,
+    train_meta: Optional[dict] = None,
+) -> Future:
+    """`save_checkpoint` with the serialize+write half on the background
+    writer: snapshots state to host NOW (cheap single device_get; also the
+    correctness boundary — the next step donates these buffers), returns a
+    Future that resolves to ``path`` when the file is durably in place.
+    Callers must eventually ``result()`` the future (the trainer drains
+    its list when training ends) or a failed write would pass silently.
+    """
+    global _writer_queue
+    payload = _build_payload(
+        params,
+        opt_state,
+        scheduler_state,
+        step,
+        epoch,
+        records_state,
+        model_state,
+        train_meta,
+    )
+    with _writer_lock:
+        if _writer_queue is None:
+            import queue as queue_mod
+
+            _writer_queue = queue_mod.Queue()
+            threading.Thread(
+                target=_writer_loop,
+                args=(_writer_queue,),
+                daemon=True,
+                name="dpt-ckpt-writer",
+            ).start()
+    fut: Future = Future()
+    _writer_queue.put((fut, path, payload))
+    return fut
 
 
 def resolve_checkpoint(name: str, checkpoint_dir: str = "./checkpoints") -> str:

@@ -66,8 +66,21 @@ def get_args():
     parser.add_argument("--num-workers", type=int, default=4,
                         help="Host-side decode threads")
     parser.add_argument("--prefetch-batches", type=int, default=2,
-                        help="Batches placed on device ahead of compute "
-                             "(each pins one batch of HBM; 0 = synchronous)")
+                        help="Batches (or K-stacks) placed on device ahead "
+                             "of compute (each pins one payload of HBM; "
+                             "0 = synchronous)")
+    parser.add_argument("--host-cache-mb", type=int, default=1024,
+                        help="Host RAM budget (MiB) for the epoch-persistent "
+                             "decoded-sample cache; epochs >= 2 skip decode "
+                             "for whatever fits (0 = off)")
+    parser.add_argument("--sync-checkpoint", action="store_true",
+                        help="Write checkpoints synchronously instead of on "
+                             "the background writer thread")
+    parser.add_argument("--trace-timeline", type=str, default=None,
+                        metavar="PATH",
+                        help="Append per-phase step-timeline spans "
+                             "(decode/stack/h2d/dispatch/readback) to this "
+                             "JSONL file; summarize with bench.py")
     parser.add_argument("--steps-per-dispatch", type=int, default=1,
                         help="Optimizer steps fused into one XLA dispatch "
                              "(amortizes runtime dispatch latency)")
@@ -169,6 +182,9 @@ def main():
         pipeline_cuts=tuple(args.pipeline_cuts) if args.pipeline_cuts else None,
         num_workers=args.num_workers,
         prefetch_batches=args.prefetch_batches,
+        host_cache_mb=args.host_cache_mb,
+        async_checkpoint=not args.sync_checkpoint,
+        timeline_path=args.trace_timeline,
         steps_per_dispatch=args.steps_per_dispatch,
         grad_accum=args.grad_accum,
         remat=args.remat,

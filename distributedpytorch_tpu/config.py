@@ -49,8 +49,17 @@ class TrainConfig:
     num_workers: int = 0  # host-side prefetch threads (0 = synchronous)
     # Device-placement prefetch depth: host→device transfer of batch i+1..i+k
     # overlaps the device's compute of batch i (transfers are comparable to
-    # the step time on tunneled/remote runtimes). 0 = place synchronously.
+    # the step time on tunneled/remote runtimes). Applies to K-stacked
+    # dispatch payloads too (the whole stack/place pipeline runs on the
+    # worker, see utils/prefetch.pipelined_placement). 0 = place
+    # synchronously (the bitwise-identical baseline the equivalence tests
+    # compare against).
     prefetch_batches: int = 2
+    # Epoch-persistent decoded-sample cache budget (data/dataset.SampleCache,
+    # MiB of host RAM): epochs >= 2 serve whatever fit from memory instead of
+    # re-running PIL/libjpeg decode on identical files every epoch. Shared by
+    # the train and val loaders. 0 disables. No eviction — see SampleCache.
+    host_cache_mb: int = 1024
 
     # -- pipeline (MP) ------------------------------------------------------
     num_microbatches: int = 2  # reference hardcodes 2 (unet_model.py:25)
@@ -110,6 +119,12 @@ class TrainConfig:
     checkpoint_every_epochs: int = 1
     # Keep a separate <method>_best.ckpt at the highest val Dice seen.
     save_best: bool = False
+    # Serialize + write checkpoints on a background thread (the device→host
+    # snapshot still happens inline — donated buffers force that): epoch
+    # saves stop stalling the step loop. The trainer drains pending writes
+    # before train() returns, so a checkpoint is always durable by the time
+    # anything could read it. False = fully synchronous saves.
+    async_checkpoint: bool = True
     # Stop when val loss has not improved for N consecutive epochs
     # (0 = off). Deterministic across processes: every rank sees the same
     # val loss (sharded eval returns identical values everywhere), so all
@@ -153,6 +168,10 @@ class TrainConfig:
     # -- observability ------------------------------------------------------
     metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)
     profile_dir: Optional[str] = None  # jax.profiler trace capture when set
+    # Step-timeline tracer (utils/trace.py): per-phase host spans
+    # (decode/stack/h2d/dispatch/readback) appended to this JSONL path;
+    # summarized by bench.py. None = tracing off (no-op call sites).
+    timeline_path: Optional[str] = None
 
     @property
     def val_fraction(self) -> float:

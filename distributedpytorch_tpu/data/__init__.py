@@ -10,6 +10,7 @@ host-side prefetch, and NHWC batches ready for `jax.device_put`.
 from distributedpytorch_tpu.data.dataset import (  # noqa: F401
     BasicDataset,
     CarvanaDataset,
+    SampleCache,
     SyntheticSegmentationDataset,
     build_dataset,
     write_synthetic_carvana_tree,

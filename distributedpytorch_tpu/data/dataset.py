@@ -27,9 +27,10 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from os.path import splitext
 from pathlib import Path
-from typing import Dict, Sequence, Tuple
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 from PIL import Image
@@ -42,6 +43,93 @@ except ImportError:  # pragma: no cover - a broken/absent native layer must
 logger = logging.getLogger(__name__)
 
 Item = Dict[str, np.ndarray]
+
+
+class SampleCache:
+    """Epoch-persistent, memory-budgeted cache of decoded samples.
+
+    The epoch loop re-reads the SAME samples every epoch, yet the seed
+    pipeline re-ran PIL/libjpeg decode + resize for each of them, every
+    epoch — on a 1-core host that decode bound the whole run
+    (docs/PERFORMANCE.md input-pipeline table; VERDICT r05 item 7 asks
+    for one-time host staging). This cache sits under DataLoader's batch
+    assembly: the first epoch decodes and stores items until the byte
+    budget is full, later epochs serve hits straight from host memory.
+
+    Deliberately no eviction: the access pattern is a uniform re-scan of
+    the whole epoch (reshuffled order, same set), where any
+    evict-on-full policy would thrash — every sample displaced is one
+    that will be needed again next epoch. Whatever fits stays for the
+    run; the remainder decodes each epoch, so a too-small budget
+    degrades smoothly toward the uncached behavior.
+
+    Sharded multi-process runs reshuffle BEFORE striding, so a rank's
+    per-epoch sample set changes: epoch 2 is not a pure re-scan and its
+    hit rate starts at ~|shard ∩ cached| rather than ~100%. Because
+    nothing is evicted, each rank's cache still grows monotonically
+    toward the full (budget-bounded) dataset and the hit rate converges
+    over a few epochs — warm-up is slower, the steady state is the same.
+    Size the per-process budget accordingly (it is per rank, not global).
+
+    Thread-safe: the loader's decode pool and the placement worker hit
+    it concurrently. Stored arrays are shared across epochs — callers
+    must treat items as read-only (batch assembly np.stack-copies, so
+    nothing downstream mutates them).
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._items: Dict[int, Item] = {}
+        self._lock = threading.Lock()
+        self.used_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self._full_logged = False
+
+    @staticmethod
+    def _nbytes(item: Item) -> int:
+        return sum(int(np.asarray(v).nbytes) for v in item.values())
+
+    def get(self, idx: int) -> Optional[Item]:
+        with self._lock:
+            item = self._items.get(idx)
+            if item is None:
+                self.misses += 1
+            else:
+                self.hits += 1
+            return item
+
+    def put(self, idx: int, item: Item) -> bool:
+        """Store if the budget allows; returns whether it was stored."""
+        size = self._nbytes(item)
+        with self._lock:
+            if idx in self._items:
+                return True
+            if self.used_bytes + size > self.budget_bytes:
+                if not self._full_logged:
+                    self._full_logged = True
+                    logger.info(
+                        "sample cache full at %d items / %.1f MiB (budget "
+                        "%.1f MiB) — remaining samples decode every epoch",
+                        len(self._items),
+                        self.used_bytes / 2**20,
+                        self.budget_bytes / 2**20,
+                    )
+                return False
+            # decouple from any whole-batch parent buffer: a row view
+            # would pin the full decoded batch even when only this row
+            # fits (np.array(copy=True), NOT ascontiguousarray — a
+            # first-axis slice is already contiguous and would be
+            # returned uncopied, silently retaining the parent)
+            self._items[idx] = {
+                k: np.array(v, copy=True) for k, v in item.items()
+            }
+            self.used_bytes += size
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
 
 
 class BasicDataset:

@@ -27,6 +27,8 @@ from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
+
 Batch = Dict[str, np.ndarray]
 
 
@@ -88,6 +90,8 @@ class DataLoader:
         seed: int = 0,
         shard: ShardSpec = ShardSpec(),
         num_workers: int = 0,
+        cache=None,
+        tracer=None,
     ):
         self.dataset = dataset
         self.indices = (
@@ -99,6 +103,12 @@ class DataLoader:
         self.seed = seed
         self.shard_spec = shard
         self.num_workers = int(num_workers)
+        # epoch-persistent decoded-sample cache (data/dataset.SampleCache),
+        # shared across loaders of the same dataset (train + val): epochs
+        # >= 2 serve whatever fit the budget from host memory, skipping
+        # decode entirely
+        self.cache = cache
+        self.tracer = tracer or NULL_TIMELINE
         self._pool = (
             ThreadPoolExecutor(max_workers=self.num_workers)
             if self.num_workers > 0
@@ -128,9 +138,39 @@ class DataLoader:
         return self.shard_spec.shard(order)
 
     def _load_batch(self, idx_list) -> Batch:
-        """Assemble one batch; uses the native C++ whole-batch path (decode +
-        resize + normalize, threaded in C, see data/native.py) when the
-        dataset is filesystem-backed with supported formats."""
+        """Assemble one batch, serving cached samples from host memory and
+        decoding only the misses (traced as the pipeline's ``decode``
+        phase — on a warm cache the span collapses to stack-only time)."""
+        with self.tracer.span("decode", n=len(idx_list)):
+            if self.cache is None:
+                return self._decode_batch(idx_list)
+            items = {int(i): self.cache.get(int(i)) for i in idx_list}
+            missing = [i for i, it in items.items() if it is None]
+            if missing:
+                fresh = self._decode_batch(missing)
+                for row, i in enumerate(missing):
+                    item = {
+                        "image": fresh["image"][row],
+                        "mask": fresh["mask"][row],
+                    }
+                    self.cache.put(i, item)
+                    items[i] = item
+                if len(missing) == len(idx_list):
+                    # nothing came from cache and indices were unique
+                    # (len matches): fresh IS the batch, already in idx
+                    # order — the steady state of a full cache must not
+                    # pay a redundant split + re-stack per batch
+                    return fresh
+            return {
+                "image": np.stack([items[int(i)]["image"] for i in idx_list]),
+                "mask": np.stack([items[int(i)]["mask"] for i in idx_list]),
+            }
+
+    def _decode_batch(self, idx_list) -> Batch:
+        """Decode one batch from the backing dataset; uses the native C++
+        whole-batch path (decode + resize + normalize, threaded in C, see
+        data/native.py) when the dataset is filesystem-backed with
+        supported formats."""
         ds = self.dataset
         if getattr(ds, "use_native", False) and hasattr(ds, "resolve_paths"):
             try:

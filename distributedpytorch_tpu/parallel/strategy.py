@@ -41,6 +41,18 @@ def _prep_mask(mask: jax.Array) -> jax.Array:
     return mask[..., None].astype(jnp.float32)
 
 
+def _state_donation() -> tuple:
+    """``donate_argnums`` for the jitted train steps: donating the state
+    halves HBM pressure on accelerators (in-place Adam update), but the
+    jax 0.4.37 CPU client intermittently ABORTS (native SIGABRT/SIGSEGV,
+    no Python traceback) when donated executables from sequentially-built
+    trainers run in one process — reproduced at ~40-50% on the restart
+    tests (two Trainers per process) and ~10% on a plain resume, 0/15
+    with donation off, seed code either way. CPU donation saves nothing
+    (buffers are host RAM regardless), so donate only off-CPU."""
+    return () if jax.default_backend() == "cpu" else (0,)
+
+
 class Strategy:
     """Base: single-controller, no mesh (one device)."""
 
@@ -88,6 +100,19 @@ class Strategy:
         dev = jax.devices()[0]
         return jax.device_put(state, dev)
 
+    def place_work(self, kind: str, payload):
+        """The async step pipeline's H2D entry (utils/prefetch.
+        pipelined_placement): one call placing either work-item kind, so
+        the placement worker needs no strategy knowledge. ``'single'`` is
+        a per-step host batch (→ `place_batch`); ``'stack'`` is an
+        already-np.stack'ed (K, B, ...) fused-dispatch payload
+        (→ `place_stacked_batch`). Replaces the trainer's historical
+        inline placement calls — every epoch-loop batch now flows through
+        here, on the worker thread when prefetch depth > 0."""
+        if kind == "stack":
+            return self.place_stacked_batch(payload)
+        return self.place_batch(payload)
+
     # -- compiled steps -----------------------------------------------------
     def _train_loss_impl(self) -> Optional[Callable]:
         """The fused Pallas training loss under ``--pallas`` (None = XLA
@@ -126,14 +151,14 @@ class Strategy:
         )
 
     def build_train_step(self, model, tx) -> Callable:
-        return jax.jit(self._raw_step(model, tx), donate_argnums=(0,))
+        return jax.jit(self._raw_step(model, tx), donate_argnums=_state_donation())
 
     def build_multi_train_step(self, model, tx) -> Callable:
         """K steps per dispatch: `multi(state, stacked) -> (state, losses)`
         with batches stacked on a leading axis (see make_multi_train_step;
         place the stacked batch with `place_stacked_batch`)."""
         multi = make_multi_train_step(self._raw_step(model, tx))
-        return jax.jit(multi, donate_argnums=(0,))
+        return jax.jit(multi, donate_argnums=_state_donation())
 
     def build_accum_train_step(self, model, tx) -> Callable:
         """ONE optimizer step over config.grad_accum stacked batches with
@@ -150,7 +175,7 @@ class Strategy:
             remat=self.config.remat,
             use_pallas=self.config.use_pallas and self.mesh is None,
         )
-        return jax.jit(step, donate_argnums=(0,))
+        return jax.jit(step, donate_argnums=_state_donation())
 
     def place_stacked_batch(
         self, stacked: Dict[str, np.ndarray]

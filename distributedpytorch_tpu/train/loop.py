@@ -19,9 +19,17 @@ Deliberate fixes over the reference (each flagged in SURVEY.md §2):
     rank-divergent lr cannot happen: lr lives in replicated optimizer state);
   * per-epoch reshuffle of the sharded train set (missing set_epoch, §3.2).
 
-Host/device split (SURVEY.md §7 hard-part 2): the jitted step returns the
-loss as a device scalar; the host blocks on it only when a metrics row is
-due, keeping steps dispatch-async the rest of the time.
+Host/device split (SURVEY.md §7 hard-part 2): the epoch loop is a fully
+overlapped pipeline. Decoded samples persist across epochs in a
+memory-budgeted host cache (data/dataset.SampleCache); stacking and
+host→device placement run on a prefetch worker `prefetch_batches` payloads
+ahead of the step loop (utils/prefetch.pipelined_placement → the
+strategy's `place_work`); the jitted step returns the loss as a device
+scalar that LossRecords drains asynchronously at row/epoch boundaries;
+and checkpoint serialization+writes run on a background writer thread
+(checkpoint.save_checkpoint_async), drained before train() returns. Each
+phase is observable through the step-timeline tracer (utils/trace.py,
+``--trace-timeline``).
 """
 
 from __future__ import annotations
@@ -35,15 +43,28 @@ from typing import Optional
 import jax
 import numpy as np
 
-from distributedpytorch_tpu.checkpoint import load_checkpoint, save_checkpoint
+from distributedpytorch_tpu.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+    save_checkpoint_async,
+)
 from distributedpytorch_tpu.config import TrainConfig
-from distributedpytorch_tpu.data import DataLoader, build_dataset, seeded_split
+from distributedpytorch_tpu.data import (
+    DataLoader,
+    SampleCache,
+    build_dataset,
+    seeded_split,
+)
 from distributedpytorch_tpu.evaluate import evaluate, evaluate_sharded
 from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rate
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
 from distributedpytorch_tpu.utils.metrics import LossRecords
-from distributedpytorch_tpu.utils.prefetch import bounded_prefetch
+from distributedpytorch_tpu.utils.prefetch import (
+    pipelined_placement,
+    stacked_work,
+)
+from distributedpytorch_tpu.utils.trace import StepTimeline
 
 logger = logging.getLogger(__name__)
 
@@ -64,6 +85,21 @@ class Trainer:
         self.strategy = strategy or build_strategy(config)
         self.dataset = dataset if dataset is not None else self._build_dataset()
         self.rng = rng if rng is not None else jax.random.key(config.seed)
+        # step-timeline tracer (utils/trace.py): disabled unless configured;
+        # main process only — co-row processes would interleave one file
+        self.tracer = StepTimeline(
+            config.timeline_path if self.strategy.is_main else None
+        )
+        # ONE epoch-persistent decoded-sample cache shared by the train and
+        # val loaders (they index the same dataset)
+        self.sample_cache = (
+            SampleCache(int(config.host_cache_mb) * 2**20)
+            if config.host_cache_mb > 0
+            else None
+        )
+        # futures of in-flight async checkpoint writes; drained (and their
+        # errors surfaced) when train() ends
+        self._ckpt_futures = []
 
         # model + state
         from distributedpytorch_tpu.models import create_model
@@ -124,6 +160,8 @@ class Trainer:
             seed=config.seed,
             shard=self.strategy.data_shard(),
             num_workers=config.num_workers,
+            cache=self.sample_cache,
+            tracer=self.tracer,
         )
         # Val: drop_last=True (reference train_utils.py:42). The loader is
         # unsharded — batch formation is identical everywhere — but
@@ -140,6 +178,7 @@ class Trainer:
             shuffle=False,
             drop_last=True,
             num_workers=config.num_workers,
+            cache=self.sample_cache,
         )
 
         self.train_step = self.strategy.build_train_step(self.model, self.tx)
@@ -175,7 +214,10 @@ class Trainer:
             else None
         )
         self.records = LossRecords(
-            config.method_tag, config.loss_dir, every=config.metric_every_steps
+            config.method_tag,
+            config.loss_dir,
+            every=config.metric_every_steps,
+            tracer=self.tracer,
         )
         if getattr(self, "_restored_records", None):
             # a resumed run appends to the run's metric history instead of
@@ -253,8 +295,32 @@ class Trainer:
         if not self.strategy.is_main or epoch == getattr(self, "_last_saved_epoch", None):
             return
         self._last_saved_epoch = epoch
-        save_checkpoint(
-            self._ckpt_path(),
+        self._save_tagged(self._ckpt_path(), epoch)
+
+    def _save_tagged(self, path: str, epoch: int) -> None:
+        """One checkpoint save — async (host snapshot inline, serialize +
+        write on the background writer) unless config.async_checkpoint is
+        off. Async futures are drained when train() ends, so the file is
+        durable before anything outside the run can read it."""
+        if self.config.async_checkpoint:
+            # surface a failed EARLIER write now, not at the end of the
+            # run (a disk-full at epoch 1 of 100 must not let 99 epochs
+            # believe their checkpoints are landing), and bound the queue:
+            # with >2 writes still in flight the filesystem is stalled —
+            # block on the oldest (the synchronous behavior) rather than
+            # accumulate full-model payloads in RAM without limit
+            for fut in [f for f in self._ckpt_futures if f.done()]:
+                self._ckpt_futures.remove(fut)
+                fut.result()  # raises if the write failed
+            while len(self._ckpt_futures) > 2:
+                self._ckpt_futures.pop(0).result()
+        save_fn = (
+            save_checkpoint_async
+            if self.config.async_checkpoint
+            else save_checkpoint
+        )
+        fut = save_fn(
+            path,
             self.state.params,
             self.state.opt_state,
             self.scheduler.state_dict(),
@@ -264,6 +330,24 @@ class Trainer:
             model_state=self.state.model_state,
             train_meta=self._train_meta(),
         )
+        if fut is not None:
+            self._ckpt_futures.append(fut)
+
+    def _drain_checkpoint_futures(self, raise_errors: bool) -> None:
+        """Block until every queued async checkpoint write is on disk.
+        Write errors re-raise when asked (normal exit) and are logged
+        otherwise (already unwinding another exception — masking it with
+        a secondary I/O error would hide the real failure)."""
+        futures, self._ckpt_futures = self._ckpt_futures, []
+        first_exc = None
+        for fut in futures:
+            try:
+                fut.result()
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                logger.exception("async checkpoint write failed")
+                first_exc = first_exc or exc
+        if first_exc is not None and raise_errors:
+            raise first_exc
 
     def _train_meta(self) -> dict:
         return {
@@ -327,36 +411,26 @@ class Trainer:
         )
         return bool(np.any(flags))
 
-    def _prefetch_placed(self, batches, depth: int):
-        """Yield ``(host_batch, device_batch)`` with device placement running
-        ``depth`` batches ahead on a worker thread.
-
-        ``place_batch`` is a blocking host→device transfer (~95 ms for a
-        reference-config batch over a tunneled TPU runtime — comparable to
-        the 108 ms step itself); placing synchronously in the step loop
-        serializes transfer behind compute and halves end-to-end
-        throughput. The worker stays ``depth`` batches ahead, so transfers
-        ride under the device's queued dispatches.
-
-        Runs on utils/prefetch.py's daemon-thread variant: device placement
-        can wedge indefinitely on an unreachable remote runtime, and a
-        non-daemon worker would then both pin placed batches in device
-        memory and block interpreter exit via concurrent.futures' atexit
-        join. The epoch loop closes the generator on early exit
-        (contextlib.closing), which stops the worker within its put-poll
-        interval.
-        """
-        return bounded_prefetch(batches, self.strategy.place_batch, depth=depth)
-
     def train(self) -> dict:
         """Run the configured epochs; signal handlers are scoped to the run
         (try/finally: an exception mid-epoch must not leave the process
-        uninterruptible)."""
+        uninterruptible). Every queued async checkpoint write is drained
+        before returning OR raising — a crash-restart rebuilds the next
+        Trainer from the checkpoint file, which must be fully on disk by
+        then."""
         self._install_signal_handler()
+        ok = False
         try:
-            return self._run()
+            result = self._run()
+            ok = True
+            return result
         finally:
             self._restore_signal_handler()
+            # flush BEFORE draining checkpoints: a failed write raises out
+            # of the drain, and the final epoch's timeline spans are most
+            # valuable exactly when diagnosing that failing run
+            self.tracer.flush()
+            self._drain_checkpoint_futures(raise_errors=ok)
 
     def _run(self) -> dict:
         cfg = self.config
@@ -396,23 +470,19 @@ class Trainer:
                     n_imgs = batch["image"].shape[0]
                     if placed is None:
                         placed = self.strategy.place_batch(batch)
-                    self.state, loss = self.train_step(self.state, placed)
+                    with self.tracer.span("dispatch", step=global_step + 1):
+                        self.state, loss = self.train_step(self.state, placed)
                     global_step += 1
-                    # loss stays a device scalar; LossRecords syncs it to host
-                    # only when a 10-step metrics row is due
+                    # loss stays a device scalar; LossRecords drains it to
+                    # host only at the next row/flush boundary
                     self._record(loss, n_imgs, global_step, pbar)
 
-                def stack_and_place(buffered):
-                    stacked = {
-                        key: np.stack([b[key] for b in buffered])
-                        for key in buffered[0]
-                    }
-                    return self.strategy.place_stacked_batch(stacked)
-
-                def run_stack(buffered):
+                def run_stack(buffered, placed):
                     nonlocal global_step
-                    placed = stack_and_place(buffered)
-                    self.state, losses = self.multi_step(self.state, placed)
+                    with self.tracer.span(
+                        "dispatch", step=global_step + 1, k=len(buffered)
+                    ):
+                        self.state, losses = self.multi_step(self.state, placed)
                     # ONE memoized device→host pull for the whole (K,) loss
                     # array, and only when a metrics row actually needs it —
                     # slicing losses[i] here would issue K extra dispatches
@@ -425,18 +495,25 @@ class Trainer:
                                 memo["host"] = np.asarray(losses)
                             return memo["host"][i]
 
+                        # LossRecords' non-blocking drain starts an async
+                        # host copy when a row is parked; expose the (K,)
+                        # array's hook so the fused-dispatch path gets the
+                        # same early D2H streaming as plain device scalars
+                        pull.copy_to_host_async = losses.copy_to_host_async
                         return pull
 
                     for i, b in enumerate(buffered):
                         global_step += 1
                         self._record(lazy(i), b["image"].shape[0], global_step, pbar)
 
-                def run_accum(buffered):
+                def run_accum(buffered, placed):
                     # ONE optimizer step over the K stacked batches —
                     # effective batch K·b, exact loss (make_accum_train_step)
                     nonlocal global_step
-                    placed = stack_and_place(buffered)
-                    self.state, loss = self.accum_step(self.state, placed)
+                    with self.tracer.span(
+                        "dispatch", step=global_step + 1, k=len(buffered)
+                    ):
+                        self.state, loss = self.accum_step(self.state, placed)
                     global_step += 1
                     self._record(
                         loss,
@@ -452,49 +529,44 @@ class Trainer:
                 run_buffered = (
                     run_stack if self.multi_step is not None else run_accum
                 )
-                buffer = []
                 single_process = jax.process_count() == 1
-                source = self.train_loader.epoch_batches(epoch)
-                if not stacking and cfg.prefetch_batches > 0:
-                    source = self._prefetch_placed(source, cfg.prefetch_batches)
-                else:
-                    # the stacked paths place whole K-stacks themselves
-                    source = ((b, None) for b in source)
+                # The async step pipeline (utils/prefetch.py): the epoch's
+                # batch stream becomes SINGLE/STACK work items whose
+                # np.stack + device placement run on the prefetch worker,
+                # `prefetch_batches` payloads ahead of this loop — batch
+                # N+1's H2D rides under batch N's executing dispatch. Depth
+                # 0 degrades to inline placement (the synchronous baseline;
+                # identical loss sequence either way).
+                source = pipelined_placement(
+                    stacked_work(
+                        self.train_loader.epoch_batches(epoch),
+                        stack_size if stacking else 1,
+                        cfg.batch_size,
+                    ),
+                    self.strategy.place_work,
+                    depth=cfg.prefetch_batches,
+                    tracer=self.tracer,
+                )
                 # closing(): breaking out mid-epoch (signal stop) must CLOSE
-                # the prefetch generator so its worker stops and queued
-                # device-placed batches get released — GC-time cleanup would
-                # keep them pinned through the checkpoint save
+                # the pipeline generator so its worker stops and queued
+                # device-placed payloads get released — GC-time cleanup would
+                # keep them pinned through the checkpoint save. Work items
+                # past the stop (including a partial group's drained
+                # singles) are simply never stepped: they were never
+                # trained, so skipping them loses nothing, and a preemption
+                # grace window may be ticking.
                 with contextlib.closing(source):
-                    for batch, placed in source:
+                    for (kind, payload), placed in source:
                         # mid-epoch stop is single-process only: in
                         # multi-process runs ranks must agree (epoch
                         # boundary) or collectives desync and hang — see
                         # _install_signal_handler
                         if self._stop_requested and single_process:
                             break
-                        if not stacking:
-                            run_one(batch, placed)
-                            continue
-                        # only full, uniformly-shaped batches can stack into
-                        # the scanned executable; the tail falls through to
-                        # run_one
-                        if batch["image"].shape[0] == cfg.batch_size:
-                            buffer.append(batch)
-                            if len(buffer) == stack_size:
-                                run_buffered(buffer)
-                                buffer = []
+                        if kind == "single":
+                            run_one(payload, placed)
                         else:
-                            for b in buffer:
-                                run_one(b)
-                            buffer = []
-                            run_one(batch)
-                for b in buffer:
-                    # never train buffered batches past a stop request: they
-                    # were never stepped, so skipping them loses nothing, and
-                    # a preemption grace window may be ticking
-                    if self._stop_requested and single_process:
-                        break
-                    run_one(b)
+                            run_buffered(payload, placed)
 
             if self._stop_agreed():
                 # save a resumable snapshot at the last COMPLETED epoch —
@@ -544,22 +616,16 @@ class Trainer:
                 val_dice,
                 self.records.images_per_second(),
             )
+            # append this epoch's timeline spans (no-op when tracing is off)
+            self.tracer.flush()
             if (
                 cfg.save_best
                 and self.strategy.is_main
                 and val_dice > self._best_dice
             ):
                 self._best_dice = val_dice
-                save_checkpoint(
-                    self._ckpt_path(f"{cfg.method_tag}_best"),
-                    self.state.params,
-                    self.state.opt_state,
-                    self.scheduler.state_dict(),
-                    step=int(self.state.step),
-                    epoch=epoch + 1,
-                    records_state=self.records.state_dict(),
-                    model_state=self.state.model_state,
-                    train_meta=self._train_meta(),
+                self._save_tagged(
+                    self._ckpt_path(f"{cfg.method_tag}_best"), epoch + 1
                 )
                 logger.info(
                     "New best val Dice %.4f at epoch %d → %s",

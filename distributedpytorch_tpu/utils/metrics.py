@@ -12,6 +12,16 @@ to a separate file so the pickle schema stays reference-compatible.
 Unlike the reference, the output directory is created on demand — the
 reference crashes at save time because ``./loss/{method}/`` never exists
 (SURVEY.md §2 component 13).
+
+Non-blocking by design (the async step pipeline's readback leg): a
+metrics row falling due no longer forces the device→host pull on the
+spot. The row's window of device scalars is parked as *pending* — with a
+best-effort ``copy_to_host_async`` started immediately, so the bytes
+stream back under later dispatches — and materialized at the NEXT row
+boundary (by which time its steps are a full window old and the copies
+have landed: no stall) or at any flush point (epoch validation,
+checkpoint ``state_dict``, ``save``). Values are bit-identical to the
+blocking scheme; only when the host blocks changes.
 """
 
 from __future__ import annotations
@@ -22,17 +32,38 @@ from typing import List, Optional
 
 import numpy as np
 
+from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
+
+
+def _start_async_copy(x) -> None:
+    """Kick off a non-blocking device→host copy where the array supports
+    it (jax.Array does; plain floats and lazy callables don't need it)."""
+    try:
+        x.copy_to_host_async()
+    except AttributeError:
+        pass
+
 
 class LossRecords:
     """Accumulates train/val loss rows and writes reference-format pickles."""
 
-    def __init__(self, method_tag: str, loss_dir: str = "./loss", every: int = 10):
+    def __init__(
+        self,
+        method_tag: str,
+        loss_dir: str = "./loss",
+        every: int = 10,
+        tracer=None,
+    ):
         self.method_tag = method_tag
         self.loss_dir = loss_dir
         self.every = every
+        self.tracer = tracer or NULL_TIMELINE
         self.start_time = time.time()
         self.losses: List[float] = []
         self.train_rows: List[list] = []  # [step, time_s, mean-of-last-10 loss]
+        # rows due but not yet drained to host: [step, time_s, lo, hi] with
+        # (lo, hi) the window's index range in self.losses
+        self._pending_rows: List[list] = []
         self.val_rows: List[list] = []  # [step, time_s, val loss]
         self.dice_rows: List[list] = []  # [step, time_s, val dice] (new)
         self.images_seen = 0
@@ -47,11 +78,11 @@ class LossRecords:
         (reference train_utils.py:67, 75-79).
 
         `loss` may be a device scalar OR a zero-arg callable returning one
-        (the multi-step path defers slicing its (K,) loss array until a row
-        is due — slicing eagerly would issue K extra device dispatches and
-        undo the dispatch amortization). Either way nothing is forced to
-        host until a metrics row is due, so the train loop stays
-        dispatch-async between rows (one host sync per `every` steps)."""
+        (the multi-step path defers slicing its (K,) loss array until its
+        row drains — slicing eagerly would issue K extra device dispatches
+        and undo the dispatch amortization). Nothing blocks here: a due
+        row drains the PREVIOUS pending row (its async copies are a full
+        window old) and parks its own window for the next boundary."""
         self.losses.append(loss)
         self.images_seen += batch_images
         if self._steady_t0 is None:
@@ -60,14 +91,36 @@ class LossRecords:
             self._steady_t0 = time.time()
             self._steady_images0 = self.images_seen
         if step % self.every == 0:
-            window = [float(x() if callable(x) else x) for x in self.losses[-self.every :]]
-            self.losses[-self.every :] = window
-            self.train_rows.append([step, time.time() - self.start_time, float(np.mean(window))])
+            self.drain()
+            lo = max(0, len(self.losses) - self.every)
+            hi = len(self.losses)
+            for x in self.losses[lo:hi]:
+                _start_async_copy(x)
+            self._pending_rows.append(
+                [step, time.time() - self.start_time, lo, hi]
+            )
+
+    def drain(self) -> None:
+        """Materialize pending rows: force their loss windows to host (the
+        pipeline's ``readback`` phase) and append the finished
+        [step, time, mean] rows. The Time column keeps the timestamp of
+        when the row fell DUE, not when it drained."""
+        if not self._pending_rows:
+            return
+        pending, self._pending_rows = self._pending_rows, []
+        with self.tracer.span("readback", rows=len(pending)):
+            for step, ts, lo, hi in pending:
+                window = [
+                    float(x() if callable(x) else x) for x in self.losses[lo:hi]
+                ]
+                self.losses[lo:hi] = window
+                self.train_rows.append([step, ts, float(np.mean(window))])
 
     def state_dict(self) -> dict:
         """Serializable metric history for checkpointing (msgpack-plain:
-        nested lists and numbers only). Pending lazy losses are forced —
-        the checkpoint must not hold device references."""
+        nested lists and numbers only). Pending rows and lazy losses are
+        forced — the checkpoint must not hold device references."""
+        self.drain()
         window = [float(x() if callable(x) else x) for x in self.losses]
         self.losses[:] = window
         return {
@@ -92,12 +145,14 @@ class LossRecords:
         self.images_seen = int(state["images_seen"])
         self.start_time = time.time() - float(state["elapsed"])
         self.losses = [float(x) for x in state.get("window") or []]
+        self._pending_rows = []
         # throughput clock restarts at the resumed run's first step (its
         # compile is excluded just like a fresh run's)
         self._steady_t0 = None
         self._steady_images0 = 0
 
     def record_val(self, step: int, val_loss: float, val_dice: Optional[float] = None) -> None:
+        self.drain()  # epoch boundary: the epoch's train rows land first
         now = time.time() - self.start_time
         self.val_rows.append([step, now, float(val_loss)])
         if val_dice is not None:
@@ -120,6 +175,8 @@ class LossRecords:
     def save(self) -> None:
         """Write ``{train,val}_loss.pkl`` (reference schema) + ``val_dice.pkl``."""
         import pandas as pd
+
+        self.drain()
 
         out = os.path.join(self.loss_dir, self.method_tag)
         os.makedirs(out, exist_ok=True)

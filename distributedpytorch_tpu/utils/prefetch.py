@@ -15,6 +15,15 @@ runs the work and what happens when the consumer walks away:
 
 Both yield in submission order and re-raise worker exceptions at the
 consumption point.
+
+On top of them sits the step-pipeline placement scheduler
+(:func:`stacked_work` + :func:`pipelined_placement`): the trainer's epoch
+stream of host batches becomes a stream of *work items* — K-stacks for the
+fused-dispatch paths, singles for everything else — whose np.stack and
+host→device placement run on the prefetch worker, ``depth`` items ahead of
+the consuming step loop. That is what keeps the device dispatch queue
+non-empty: batch N+1's H2D transfer rides under batch N's executing scan
+instead of serializing behind it.
 """
 
 from __future__ import annotations
@@ -23,6 +32,10 @@ import collections
 import queue as queue_mod
 import threading
 from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+
+import numpy as np
+
+from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -75,6 +88,93 @@ def bounded_prefetch(
             yield payload
     finally:
         stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Step-pipeline placement scheduler (train/loop.py's epoch source)
+# ---------------------------------------------------------------------------
+
+#: Work-item kinds flowing through the pipeline: a plain per-step batch, or
+#: a list of K same-shape batches destined for one fused dispatch
+#: (steps_per_dispatch / grad_accum).
+SINGLE = "single"
+STACK = "stack"
+
+
+def stacked_work(
+    batches: Iterable[dict], stack_size: int, batch_size: int
+) -> Iterator[Tuple[str, object]]:
+    """Group an epoch's batch stream into pipeline work items.
+
+    Only full, uniformly-shaped batches can stack into the scanned
+    executable (their shapes must all match the compiled (K, B, ...)
+    payload); a ragged batch flushes the partial group — each buffered
+    batch re-emitted as a single, THEN the ragged one — and the epoch's
+    trailing partial group drains the same way. This reproduces the
+    trainer's historical inline buffering exactly, so the (K>1) loss
+    sequence is bit-identical to the old loop's.
+
+    ``stack_size <= 1`` degenerates to all-singles.
+    """
+    if stack_size <= 1:
+        for b in batches:
+            yield (SINGLE, b)
+        return
+    buffer: list = []
+    for b in batches:
+        if b["image"].shape[0] == batch_size:
+            buffer.append(b)
+            if len(buffer) == stack_size:
+                yield (STACK, buffer)
+                buffer = []
+        else:
+            for q in buffer:
+                yield (SINGLE, q)
+            buffer = []
+            yield (SINGLE, b)
+    for q in buffer:
+        yield (SINGLE, q)
+
+
+def pipelined_placement(
+    work: Iterable[Tuple[str, object]],
+    place_fn: Callable[[str, object], object],
+    depth: int = 2,
+    tracer=None,
+) -> Iterator[Tuple[Tuple[str, object], object]]:
+    """Yield ``(work_item, placed)`` with stacking + H2D placement running
+    up to ``depth`` items ahead on the prefetch worker.
+
+    ``place_fn(kind, payload)`` is the strategy's placement entry
+    (Strategy.place_work): for a STACK item the K host batches are
+    np.stack'ed here first — on the worker thread, off the step loop —
+    then placed as one (K, B, ...) payload. ``depth <= 0`` places inline
+    on the consumer thread (the synchronous baseline; still traced), as a
+    generator so ``contextlib.closing`` works identically either way.
+
+    The ``stack``/``h2d`` tracer spans recorded here are what make the
+    overlap observable: their wall-clock windows interleave with the
+    consumer's ``dispatch`` spans when the pipeline is actually ahead.
+    """
+    tracer = tracer or NULL_TIMELINE
+    counter = {"n": 0}
+
+    def place(item):
+        kind, payload = item
+        seq = counter["n"]
+        counter["n"] += 1
+        if kind == STACK:
+            with tracer.span("stack", seq=seq):
+                payload = {
+                    key: np.stack([b[key] for b in payload])
+                    for key in payload[0]
+                }
+        with tracer.span("h2d", seq=seq, kind=kind):
+            return place_fn(kind, payload)
+
+    if depth <= 0:
+        return ((item, place(item)) for item in work)
+    return bounded_prefetch(work, place, depth=depth)
 
 
 def bounded_submit(

@@ -1,0 +1,152 @@
+"""Step-timeline tracer: per-phase host timestamps for the async pipeline.
+
+The fully-overlapped step loop (train/loop.py + utils/prefetch.py) runs
+five host-observable phases per batch —
+
+    decode    host-side sample decode / batch assembly (data/loader.py)
+    stack     np.stack of K per-step batches into one dispatch payload
+    h2d       host→device placement (strategy.place_work on the worker)
+    dispatch  the host-side step call (async: enqueue, not execution)
+    readback  device→host drain of loss scalars (utils/metrics.py)
+
+— and whether they actually overlap is invisible in aggregate throughput
+numbers. This tracer records ``(phase, t0, t1)`` wall spans (a shared
+``time.perf_counter`` clock across every thread: loader pool, placement
+worker, main loop), appends them as JSONL, and summarizes per-phase
+totals so a throughput regression is attributable to the phase that
+grew. `bench.py` emits the summary alongside imgs/sec; the overlap test
+(tests/test_async_pipeline.py) asserts on the raw spans.
+
+Disabled (the default: no path) it is a no-op cheap enough to leave the
+call sites unconditional.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+PHASES = ("decode", "stack", "h2d", "dispatch", "readback")
+
+
+class StepTimeline:
+    """Collects per-phase spans; thread-safe; JSONL-append on flush().
+
+    ``path=None`` disables collection entirely unless ``enabled=True`` is
+    forced (in-memory mode — what bench.py uses for its inline summary).
+    """
+
+    def __init__(self, path: Optional[str] = None, *, enabled: Optional[bool] = None):
+        self.path = path
+        self.enabled = (path is not None) if enabled is None else enabled
+        self._events: List[dict] = []
+        self._lock = threading.Lock()
+        # per-phase running totals survive flush(): the summary covers the
+        # whole run even though events are dumped incrementally
+        self._totals: Dict[str, List[float]] = {}  # phase -> [count, total_s]
+
+    def record(self, phase: str, t0: float, t1: float, **tags) -> None:
+        if not self.enabled:
+            return
+        event = {"phase": phase, "t0": round(t0, 6), "t1": round(t1, 6), **tags}
+        with self._lock:
+            self._events.append(event)
+            acc = self._totals.setdefault(phase, [0, 0.0])
+            acc[0] += 1
+            acc[1] += t1 - t0
+
+    @contextlib.contextmanager
+    def span(self, phase: str, **tags):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.record(phase, t0, time.perf_counter(), **tags)
+
+    def events(self, phase: Optional[str] = None) -> List[dict]:
+        """Unflushed events (optionally one phase), in record order."""
+        with self._lock:
+            evs = list(self._events)
+        return [e for e in evs if phase is None or e["phase"] == phase]
+
+    def flush(self) -> None:
+        """Append collected events to ``path`` as JSONL and clear them
+        (totals persist). In-memory mode just clears."""
+        with self._lock:
+            evs, self._events = self._events, []
+        if not evs or self.path is None:
+            return
+        with open(self.path, "a") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+
+    def summary(self) -> Dict[str, Optional[dict]]:
+        """Per-phase ``{count, total_ms, mean_ms}`` over the whole run;
+        phases never observed report None (distinguishable from 0 ms)."""
+        with self._lock:
+            totals = {k: list(v) for k, v in self._totals.items()}
+        return _format_totals(totals)
+
+
+def _format_totals(totals: Dict[str, List[float]]) -> Dict[str, Optional[dict]]:
+    """phase → [count, total_s] accumulators → the summary shape shared by
+    StepTimeline.summary and summarize_events (one formatter: bench.py
+    emits both side by side, and they must never drift apart)."""
+    out: Dict[str, Optional[dict]] = {}
+    for phase in PHASES:
+        if phase not in totals:
+            out[phase] = None
+            continue
+        count, total = totals[phase]
+        out[phase] = {
+            "count": int(count),
+            "total_ms": round(1e3 * total, 3),
+            "mean_ms": round(1e3 * total / count, 3) if count else 0.0,
+        }
+    return out
+
+
+#: Shared disabled instance for call sites whose owner passed no tracer.
+NULL_TIMELINE = StepTimeline(None)
+
+
+def load_events(path: str) -> List[dict]:
+    """Parse a timeline JSONL file, skipping torn/blank lines (the file is
+    appended mid-run; a concurrent reader can catch a partial line)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(d, dict) and "phase" in d:
+                events.append(d)
+    return events
+
+
+def summarize_events(events: Iterable[dict]) -> Dict[str, Optional[dict]]:
+    """Same per-phase shape as :meth:`StepTimeline.summary`, from raw
+    events (e.g. a trainer-written JSONL read back by bench.py)."""
+    totals: Dict[str, List[float]] = {}
+    for e in events:
+        try:
+            dt = float(e["t1"]) - float(e["t0"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        acc = totals.setdefault(e["phase"], [0, 0.0])
+        acc[0] += 1
+        acc[1] += dt
+    return _format_totals(totals)
+
+
+def summarize_timeline(path: str) -> Dict[str, Optional[dict]]:
+    return summarize_events(load_events(path))

@@ -129,6 +129,40 @@ def test_session_measurement_absent(tmp_path):
         paths=(str(tmp_path / "nope.json"),)) is None
 
 
+def test_preflight_failure_promotes_watcher_session(tmp_path, monkeypatch):
+    """When preflight fails but the watcher landed a same-session
+    measurement, the artifact's TOP-LEVEL metric/value must be that
+    measurement with provenance 'watcher_session' (VERDICT r05 item 2) —
+    not a 0.0 error line with the number buried in evidence."""
+    default = tmp_path / "bench_default.json"
+    default.write_text(json.dumps(
+        {"metric": "unet_train_imgs_per_sec_b4_640x960_tpu",
+         "value": 37.08, "unit": "imgs/sec", "step_time_ms": 107.9}) + "\n")
+    # the real scanner, pointed at the tmp artifact
+    orig = bench._session_measurement
+    monkeypatch.setattr(
+        bench, "_session_measurement",
+        lambda paths=None: orig(paths=(str(default),)))
+    history = [{"ok": False, "error": "probe timeout after 120s"}]
+    out = bench._preflight_failure_payload("preflight: dead", history)
+    assert out["value"] == 37.08
+    assert out["metric"] == "unet_train_imgs_per_sec_b4_640x960_tpu"
+    assert out["provenance"] == "watcher_session"
+    assert out["session_artifact"] == str(default)
+    assert out["preflight_error"] == "preflight: dead"
+    assert out["preflight_history"] == history
+    assert "error" not in out  # a promoted row is a measurement, not an error
+    assert out["vs_baseline"] == round(37.08 / bench.BASELINE_IMGS_PER_SEC, 3)
+
+
+def test_preflight_failure_without_session_is_error_line(monkeypatch):
+    monkeypatch.setattr(bench, "_session_measurement", lambda paths=None: None)
+    out = bench._preflight_failure_payload("preflight: dead", [])
+    assert out["value"] == 0.0
+    assert out["error"] == "preflight: dead"
+    assert "provenance" not in out
+
+
 def test_failure_evidence_never_raises(monkeypatch):
     """The evidence fields ride inside the watchdog timer thread and the
     last-resort except block — an exception THERE would produce an empty

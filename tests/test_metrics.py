@@ -29,10 +29,12 @@ def test_row_cadence_and_schema(tmp_path):
     assert dice["Dice"].tolist() == [0.25]
 
 
-def test_lazy_loss_pulled_only_when_row_due(tmp_path):
-    """The multi-step dispatch path hands a zero-arg callable; it must be
-    forced only when a metrics row is due (one host sync per `every`
-    steps), never per step."""
+def test_lazy_loss_pulled_only_at_drain_boundaries(tmp_path):
+    """The dispatch paths hand device scalars / zero-arg callables; they
+    must be forced only when their PENDING row drains — at the next row
+    boundary or a flush point — never per step, and never at the very
+    step the row falls due (that would block on the just-dispatched
+    step; the async pipeline keeps the readback a full window behind)."""
     pulls = []
 
     def lazy(v):
@@ -45,9 +47,19 @@ def test_lazy_loss_pulled_only_when_row_due(tmp_path):
     rec = LossRecords("m", loss_dir=str(tmp_path), every=3)
     for step in range(1, 4):
         rec.record_train(step, lazy(float(step)), batch_images=1)
-    assert pulls == [1.0, 2.0, 3.0]  # all pulled at the step-3 row, not before
-    rec.record_train(4, lazy(4.0), batch_images=1)
-    assert pulls == [1.0, 2.0, 3.0]  # step 4: no row due, nothing pulled
+    # the step-3 row is parked pending, nothing forced yet
+    assert pulls == []
+    assert rec.train_rows == []
+    for step in range(4, 7):
+        rec.record_train(step, lazy(float(step)), batch_images=1)
+    # the step-6 boundary drained the step-3 row (its copies are a full
+    # window old) and parked its own
+    assert pulls == [1.0, 2.0, 3.0]
+    assert [r[0] for r in rec.train_rows] == [3]
+    # any flush point (state_dict / save / record_val) forces the rest
+    rec.state_dict()
+    assert pulls == [1.0, 2.0, 3.0, 4.0, 5.0, 6.0]
+    assert [r[0] for r in rec.train_rows] == [3, 6]
 
 
 def test_images_per_second_excludes_first_step(tmp_path):
@@ -82,6 +94,7 @@ def test_state_dict_roundtrip_preserves_window(tmp_path):
     rec2.load_state_dict(state)
     rec2.record_train(7, 7.0, batch_images=1)
     rec2.record_train(8, 8.0, batch_images=1)
+    rec2.drain()  # rows are pending until a boundary/flush drains them
     # row at step 8 averages steps 5-8 — identical to an uninterrupted run
     assert rec2.train_rows[-1][0] == 8
     np.testing.assert_allclose(rec2.train_rows[-1][2], np.mean([5, 6, 7, 8]))

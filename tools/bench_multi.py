@@ -161,21 +161,34 @@ def _arm_config_watchdog(path: str, name: str, secs: float):
 def _run_one(bench, name: str, env: dict, budget: float) -> dict:
     """Point bench.py's module config at this config and run its
     measurement path (same executables/timing/fields as the driver
-    artifact)."""
-    for k in _CONFIG_ENV_KEYS:
-        os.environ.pop(k, None)
-    os.environ.update(env)
-    # run() reads the lever envs itself but takes batch/arch/geometry
-    # from module globals frozen at bench import — re-derive them here.
-    bench.BATCH = int(env.get("BENCH_BATCH", 4))
-    bench.H = int(env.get("BENCH_H", 640))
-    bench.W = int(env.get("BENCH_W", 960))
-    bench.ARCH = env.get("BENCH_ARCH", "unet")
-    # run()'s fused-executable skip gate compares elapsed-since-_START
-    # against the watchdog budget; both must be per-config here.
-    bench._START = time.monotonic()
-    os.environ["BENCH_WATCHDOG_SECS"] = str(budget)
-    return bench.run()
+    artifact). Pre-existing values of the config env keys are snapshotted
+    and restored afterward — an in-process run must not destroy ambient
+    state the caller (or an outer harness) set (ADVICE r05 low)."""
+    snapshot = {
+        k: os.environ.get(k)
+        for k in (*_CONFIG_ENV_KEYS, "BENCH_WATCHDOG_SECS")
+    }
+    try:
+        for k in _CONFIG_ENV_KEYS:
+            os.environ.pop(k, None)
+        os.environ.update(env)
+        # run() reads the lever envs itself but takes batch/arch/geometry
+        # from module globals frozen at bench import — re-derive them here.
+        bench.BATCH = int(env.get("BENCH_BATCH", 4))
+        bench.H = int(env.get("BENCH_H", 640))
+        bench.W = int(env.get("BENCH_W", 960))
+        bench.ARCH = env.get("BENCH_ARCH", "unet")
+        # run()'s fused-executable skip gate compares elapsed-since-_START
+        # against the watchdog budget; both must be per-config here.
+        bench._START = time.monotonic()
+        os.environ["BENCH_WATCHDOG_SECS"] = str(budget)
+        return bench.run()
+    finally:
+        for k, v in snapshot.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def main(argv=None) -> int:
@@ -221,51 +234,50 @@ def main(argv=None) -> int:
 
     import bench
 
-    try:
-        for name, env, budget in todo:
-            append_line(args.out, {"event": "attempting", "config": name,
-                                   "budget_s": budget})
-            dog = _arm_config_watchdog(args.out, name, budget)
-            try:
-                result = _run_one(bench, name, env, budget)
-            except Exception as exc:  # noqa: BLE001 — classified below
-                dog.cancel()
-                retryable = isinstance(
-                    exc,
-                    (RuntimeError, OSError, ConnectionError, TimeoutError))
-                # JAX surfaces deterministic config failures as
-                # XlaRuntimeError (a RuntimeError subclass) too — only a
-                # liveness probe can tell "the runtime died under this
-                # config" from "this config is just broken". A dead
-                # probe → innocent (a later window retries) and stop:
-                # nothing after it can init a backend in this process
-                # (jax caches the failed init). A healthy probe → the
-                # config itself failed deterministically → permanent,
-                # keep going with the rest.
-                if retryable and not _probe_once(
-                        args.probe_timeout).get("ok"):
-                    append_line(args.out, {
-                        "config": name,
-                        "error":
-                            f"runtime_error: {type(exc).__name__}: {exc}",
-                    })
-                    print(f"bench_multi: runtime died at config {name!r}: "
-                          f"{exc}")
-                    return 4
+    # env hygiene is per-config now: _run_one snapshots and restores the
+    # ambient values of every key it touches, so no process-wide cleanup
+    # (the old unconditional pop destroyed caller-set levers) is needed.
+    for name, env, budget in todo:
+        append_line(args.out, {"event": "attempting", "config": name,
+                               "budget_s": budget})
+        dog = _arm_config_watchdog(args.out, name, budget)
+        try:
+            result = _run_one(bench, name, env, budget)
+        except Exception as exc:  # noqa: BLE001 — classified below
+            dog.cancel()
+            retryable = isinstance(
+                exc,
+                (RuntimeError, OSError, ConnectionError, TimeoutError))
+            # JAX surfaces deterministic config failures as
+            # XlaRuntimeError (a RuntimeError subclass) too — only a
+            # liveness probe can tell "the runtime died under this
+            # config" from "this config is just broken". A dead
+            # probe → innocent (a later window retries) and stop:
+            # nothing after it can init a backend in this process
+            # (jax caches the failed init). A healthy probe → the
+            # config itself failed deterministically → permanent,
+            # keep going with the rest.
+            if retryable and not _probe_once(
+                    args.probe_timeout).get("ok"):
                 append_line(args.out, {
                     "config": name,
-                    "error": f"config_error: {type(exc).__name__}: {exc}",
+                    "error":
+                        f"runtime_error: {type(exc).__name__}: {exc}",
                 })
-                print(f"bench_multi: deterministic failure in {name!r}: "
+                print(f"bench_multi: runtime died at config {name!r}: "
                       f"{exc}")
-                continue
-            dog.cancel()
-            append_line(args.out, {"config": name, **result})
-            print(json.dumps({"config": name, **result}))
-            sys.stdout.flush()
-    finally:
-        for k in (*_CONFIG_ENV_KEYS, "BENCH_WATCHDOG_SECS"):
-            os.environ.pop(k, None)
+                return 4
+            append_line(args.out, {
+                "config": name,
+                "error": f"config_error: {type(exc).__name__}: {exc}",
+            })
+            print(f"bench_multi: deterministic failure in {name!r}: "
+                  f"{exc}")
+            continue
+        dog.cancel()
+        append_line(args.out, {"config": name, **result})
+        print(json.dumps({"config": name, **result}))
+        sys.stdout.flush()
 
     state = load_state(args.out)
     unresolved = [n for n, _, _ in CONFIGS
