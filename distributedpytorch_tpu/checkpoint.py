@@ -17,28 +17,97 @@ This module fixes that:
     reference model/unet_parts.py:9-14, 22-26, 46-54, unet_model.py:7-10)
     with NHWC↔NCHW kernel transposes. Import tolerates the DDP ``module.``
     key prefix the reference leaks into its DDP checkpoints (quirk 9).
+
+Resilience (docs/RELIABILITY.md):
+
+  * **multi-host-safe gather** — `_to_host` allgathers each leaf that is
+    sharded across processes (FSDP/TP on a pod: not fully addressable, so
+    a bare ``device_get`` would fail); the gather is COLLECTIVE, so every
+    process must reach the save path (train/loop.py builds the payload on
+    all ranks and gates only the file write to rank 0);
+  * **integrity footer** — every file carries a sha256 of its msgpack
+    payload; restore verifies it and refuses torn/corrupt bytes with
+    :class:`CheckpointCorruptError` (legacy footer-less files still load);
+  * **retention + fallback** — saves retain the newest ``keep`` files
+    (``x.ckpt``, ``x.ckpt.1``, …) and `load_checkpoint` automatically
+    falls back to the newest INTACT retained file, so a crash mid-write
+    can no longer strand a restart on a corrupt checkpoint.
 """
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import logging
 import os
 import threading
 from concurrent.futures import Future
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import flax.serialization
 import jax
 import numpy as np
 
+from distributedpytorch_tpu.utils import faults
+
+logger = logging.getLogger(__name__)
+
 CKPT_VERSION = 1
+
+# Integrity footer: payload bytes + MAGIC + sha256(payload). Fixed-size
+# trailer so the reader can split it off without parsing; files written
+# before the footer existed simply lack the MAGIC and skip verification.
+_HASH_MAGIC = b"DPT-SHA256:"
+_FOOTER_LEN = len(_HASH_MAGIC) + 32
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint file failed its integrity check (hash mismatch or
+    unparseable payload) — torn write, bit rot, or truncation."""
+
+
+def needs_collective_gather(x) -> bool:
+    """True for a leaf sharded ACROSS processes (FSDP/TP state on a pod):
+    not materializable by any single host, so `_to_host` must allgather
+    it — a collective every rank participates in. ONE definition shared
+    with the trainer's save gating (train/loop.py `_save_needs_all_ranks`):
+    if the two ever disagreed, non-main ranks would skip a payload build
+    `_to_host` treats as collective and every rank would hang."""
+    return (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.is_fully_replicated
+    )
 
 
 def _to_host(tree):
     # ONE device_get for the whole tree: per-leaf pulls are a synchronous
     # device→host round trip each (~100 ms over a tunneled runtime —
-    # ~140 leaves made every checkpoint save cost ~12 s).
-    return jax.tree.map(np.asarray, jax.device_get(tree))
+    # ~140 leaves made every checkpoint save cost ~12 s). Leaves sharded
+    # ACROSS processes (FSDP/TP state on a pod) are not fully addressable
+    # — device_get cannot materialize them — so those are allgathered per
+    # leaf instead (a collective: every process must call, in the same
+    # leaf order — jax.tree flattening order is deterministic). Fully
+    # replicated global arrays keep the cheap device_get path.
+    leaves, treedef = jax.tree.flatten(tree)
+    needs_gather = needs_collective_gather
+
+    if not any(needs_gather(x) for x in leaves):
+        return jax.tree.map(np.asarray, jax.device_get(tree))
+    from jax.experimental import multihost_utils
+
+    # one batched device_get for ALL non-gathered leaves (per-leaf pulls
+    # would reintroduce the round trips the fast path above exists to
+    # avoid); only the genuinely sharded leaves pay a collective each
+    plain_idx = [i for i, x in enumerate(leaves) if not needs_gather(x)]
+    plain = jax.device_get([leaves[i] for i in plain_idx])
+    out: list = list(leaves)
+    for i, v in zip(plain_idx, plain):
+        out[i] = np.asarray(v)
+    for i, x in enumerate(leaves):
+        if needs_gather(x):
+            out[i] = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return jax.tree.unflatten(treedef, out)
 
 
 def _build_payload(
@@ -82,17 +151,98 @@ def _build_payload(
 _TMP_COUNTER = itertools.count()
 
 
-def _write_payload(path: str, payload: dict) -> str:
-    """Serialize + atomic write (tmp + rename: a crash mid-write never
-    corrupts the previous checkpoint). Unique tmp names: queued async
-    saves of the same path must not clobber each other's tmp files."""
+def _rotate_retained(path: str, keep: int) -> None:
+    """Shift the retained chain one slot: ``path`` → ``path.1`` → … up to
+    ``path.(keep-1)``. ``keep <= 1`` keeps only the live file (no chain)."""
+    if keep <= 1 or not os.path.exists(path):
+        return
+    for i in range(keep - 1, 0, -1):
+        src = path if i == 1 else f"{path}.{i - 1}"
+        if os.path.exists(src):
+            os.replace(src, f"{path}.{i}")
+
+
+def _prune_retained(path: str, keep: int) -> None:
+    # bounded scan (not glob): retained suffixes are small ints and a
+    # lowered --keep-checkpoints may leave holes above the new limit
+    for i in range(max(1, keep), 64):
+        stale = f"{path}.{i}"
+        if os.path.exists(stale):
+            os.remove(stale)
+
+
+def retained_checkpoints(path: str) -> List[str]:
+    """The retention chain on disk, newest first (``path`` itself, then
+    ``path.1``, …) — the restore fallback order."""
+    out = [path] if os.path.exists(path) else []
+    for i in range(1, 64):
+        cand = f"{path}.{i}"
+        if os.path.exists(cand):
+            out.append(cand)
+    return out
+
+
+def _write_payload(path: str, payload: dict, keep: int = 1) -> str:
+    """Serialize + integrity footer + atomic write (tmp + rename: a crash
+    mid-write never corrupts the previous checkpoint), rotating the
+    retained chain first so the previous file survives as ``path.1``.
+    Unique tmp names: queued async saves of the same path must not
+    clobber each other's tmp files."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     blob = flax.serialization.msgpack_serialize(payload)
+    if faults.fire("ckpt_write", epoch=payload.get("epoch")):
+        # Simulate the failure retention exists for: a write that died
+        # half-way AND tore the destination (non-atomic filesystem, power
+        # loss mid-rename). Rotate like a real save, leave torn bytes at
+        # `path`, and raise — restore must fall back to `path.1`.
+        _rotate_retained(path, keep)
+        with open(path, "wb") as f:
+            f.write(blob[: max(1, len(blob) // 2)])
+        raise faults.InjectedFault(
+            f"injected ckpt_write fault: torn file left at {path}"
+        )
     tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_COUNTER)}"
     with open(tmp, "wb") as f:
         f.write(blob)
+        f.write(_HASH_MAGIC)
+        f.write(hashlib.sha256(blob).digest())
+    _rotate_retained(path, keep)
     os.replace(tmp, path)
+    _prune_retained(path, keep)
     return path
+
+
+def _read_verified(path: str) -> dict:
+    """Read + integrity-check one checkpoint file. Hash mismatch and
+    unparseable payloads (torn legacy files) both raise
+    :class:`CheckpointCorruptError`; footer-less legacy files load
+    unverified."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    if (
+        len(blob) > _FOOTER_LEN
+        and blob[-_FOOTER_LEN:-32] == _HASH_MAGIC
+    ):
+        body, digest = blob[:-_FOOTER_LEN], blob[-32:]
+        if hashlib.sha256(body).digest() != digest:
+            raise CheckpointCorruptError(
+                f"{path}: content hash mismatch (torn write or bit rot)"
+            )
+        blob = body
+    try:
+        return flax.serialization.msgpack_restore(blob)
+    except Exception as exc:
+        raise CheckpointCorruptError(f"{path}: unreadable payload: {exc}") from exc
+
+
+def verify_checkpoint(path: str) -> bool:
+    """True iff ``path`` parses and (when a footer is present) its hash
+    verifies."""
+    try:
+        _read_verified(path)
+        return True
+    except CheckpointCorruptError:
+        return False
 
 
 def save_checkpoint(
@@ -105,20 +255,25 @@ def save_checkpoint(
     records_state: Optional[dict] = None,
     model_state=None,
     train_meta: Optional[dict] = None,
+    keep: int = 1,
+    write: bool = True,
 ) -> None:
-    _write_payload(
-        path,
-        _build_payload(
-            params,
-            opt_state,
-            scheduler_state,
-            step,
-            epoch,
-            records_state,
-            model_state,
-            train_meta,
-        ),
+    """``write=False`` builds the payload WITHOUT touching disk — the
+    multi-process contract: the host snapshot inside `_build_payload` is
+    collective when state is sharded across processes, so every rank
+    calls this and only rank 0 passes ``write=True`` (train/loop.py)."""
+    payload = _build_payload(
+        params,
+        opt_state,
+        scheduler_state,
+        step,
+        epoch,
+        records_state,
+        model_state,
+        train_meta,
     )
+    if write:
+        _write_payload(path, payload, keep=keep)
 
 
 # ---------------------------------------------------------------------------
@@ -135,11 +290,11 @@ _writer_queue = None  # created lazily; holds (Future, path, payload)
 
 def _writer_loop(q):
     while True:
-        fut, path, payload = q.get()
+        fut, path, payload, keep = q.get()
         if not fut.set_running_or_notify_cancel():
             continue
         try:
-            fut.set_result(_write_payload(path, payload))
+            fut.set_result(_write_payload(path, payload, keep=keep))
         except BaseException as exc:  # surfaced via Future.result()
             fut.set_exception(exc)
 
@@ -154,13 +309,19 @@ def save_checkpoint_async(
     records_state: Optional[dict] = None,
     model_state=None,
     train_meta: Optional[dict] = None,
-) -> Future:
+    keep: int = 1,
+    write: bool = True,
+) -> Optional[Future]:
     """`save_checkpoint` with the serialize+write half on the background
-    writer: snapshots state to host NOW (cheap single device_get; also the
-    correctness boundary — the next step donates these buffers), returns a
+    writer: snapshots state to host NOW (cheap single device_get — also
+    the correctness boundary, the next step donates these buffers, AND
+    the collective boundary: a cross-process allgather must run on the
+    caller thread in rank-lockstep, never on the writer), returns a
     Future that resolves to ``path`` when the file is durably in place.
-    Callers must eventually ``result()`` the future (the trainer drains
-    its list when training ends) or a failed write would pass silently.
+    ``write=False`` (non-main ranks) participates in the snapshot and
+    returns None. Callers must eventually ``result()`` the future (the
+    trainer drains its list when training ends) or a failed write would
+    pass silently.
     """
     global _writer_queue
     payload = _build_payload(
@@ -173,6 +334,8 @@ def save_checkpoint_async(
         model_state,
         train_meta,
     )
+    if not write:
+        return None
     with _writer_lock:
         if _writer_queue is None:
             import queue as queue_mod
@@ -185,7 +348,7 @@ def save_checkpoint_async(
                 name="dpt-ckpt-writer",
             ).start()
     fut: Future = Future()
-    _writer_queue.put((fut, path, payload))
+    _writer_queue.put((fut, path, payload, keep))
     return fut
 
 
@@ -212,6 +375,11 @@ def resolve_checkpoint(name: str, checkpoint_dir: str = "./checkpoints") -> str:
         cand = os.path.join(checkpoint_dir, f"{base}{ext}")
         if os.path.isfile(cand):
             return cand
+        if ext == ".ckpt" and retained_checkpoints(cand):
+            # live slot empty but the retention chain survives (a crash
+            # between rotate and rename): resolvable — load_checkpoint's
+            # fallback walks the chain from the primary path
+            return cand
     raise FileNotFoundError(os.path.join(checkpoint_dir, f"{base}{exts[0]}"))
 
 
@@ -225,17 +393,47 @@ def load_weights(path: str, params_template):
 
 
 def load_checkpoint(
-    path: str, params_target, opt_state_target=None, model_state_target=None
+    path: str,
+    params_target,
+    opt_state_target=None,
+    model_state_target=None,
+    fallback: bool = True,
 ) -> Dict[str, Any]:
     """Restore a checkpoint into the given target structures.
+
+    Every file is integrity-checked (`_read_verified`); when ``path``
+    itself is corrupt and ``fallback`` is on, restore walks the retention
+    chain (``path.1``, ``path.2``, …) to the newest INTACT file — so a
+    crash mid-write costs one save interval of progress, not the run
+    (`fit_with_restarts` then resumes from the fallback's epoch). All
+    candidates corrupt raises :class:`CheckpointCorruptError`.
 
     Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch',
     'records', 'model_state'}``; `opt_state` is None when the checkpoint
     predates it or no target given, `records` (metric history) and
     `model_state` (BatchNorm stats) likewise.
     """
-    with open(path, "rb") as f:
-        payload = flax.serialization.msgpack_restore(f.read())
+    candidates = retained_checkpoints(path) if fallback else [path]
+    if not candidates:  # path missing entirely: keep FileNotFoundError
+        candidates = [path]
+    payload = None
+    for cand in candidates:
+        try:
+            payload = _read_verified(cand)
+            if cand != path:
+                logger.warning(
+                    "checkpoint %s is corrupt or missing — restored the "
+                    "newest intact retained file %s instead",
+                    path, cand,
+                )
+            break
+        except CheckpointCorruptError as exc:
+            logger.warning("checkpoint integrity failure: %s", exc)
+    if payload is None:
+        raise CheckpointCorruptError(
+            f"no intact checkpoint among {candidates} — every candidate "
+            "failed its integrity check"
+        )
     out = {
         "params": flax.serialization.from_state_dict(params_target, payload["params"]),
         "opt_state": None,

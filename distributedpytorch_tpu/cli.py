@@ -126,6 +126,39 @@ def get_args():
                              "consecutive epochs (0 = off)")
     parser.add_argument("--export-pth", action="store_true",
                         help="Also export final weights as a reference-format .pth")
+    # resilience (utils/faults.py, docs/RELIABILITY.md)
+    parser.add_argument("--nonfinite-policy", type=str, default="abort",
+                        choices=["abort", "rollback", "skip"],
+                        help="On a non-finite train loss: abort (raise), "
+                             "rollback (reload the newest intact checkpoint"
+                             ", bounded by --rollback-retries), or skip "
+                             "(discard that step's update; checks the loss "
+                             "synchronously per step)")
+    parser.add_argument("--rollback-retries", type=int, default=2,
+                        help="Rollback budget for --nonfinite-policy "
+                             "rollback before aborting")
+    parser.add_argument("--data-retries", type=int, default=3,
+                        help="Bounded exponential-backoff retries for "
+                             "transient decode / placement failures "
+                             "(0 = fail fast)")
+    parser.add_argument("--step-timeout", type=float, default=0.0,
+                        metavar="SECS",
+                        help="Dispatch watchdog: a step exceeding this "
+                             "dumps the step-timeline spans and "
+                             "checkpoints-and-stops (0 = off)")
+    parser.add_argument("--keep-checkpoints", type=int, default=2,
+                        help="Retain the newest N checkpoint files per "
+                             "path; restore hash-verifies and falls back "
+                             "to the newest intact one")
+    # default=None, not []: argparse appends into the default object
+    # itself, so a shared [] would leak armed faults across repeated
+    # get_args() calls in one process
+    parser.add_argument("--inject-fault", action="append", default=None,
+                        metavar="SITE:EPOCH:STEP[:COUNT]",
+                        help="Arm a deterministic fault (repeatable; "
+                             "sites: decode, placement, nan_loss, "
+                             "ckpt_write, sigterm; '*' wildcards) — for "
+                             "recovery drills and tests")
     return parser.parse_args()
 
 
@@ -198,6 +231,12 @@ def main():
         profile_dir=args.profile_dir,
         save_best=args.save_best,
         early_stop_patience=args.early_stop,
+        nonfinite_policy=args.nonfinite_policy,
+        rollback_retries=args.rollback_retries,
+        data_retries=args.data_retries,
+        step_timeout_s=args.step_timeout,
+        keep_checkpoints=args.keep_checkpoints,
+        inject_faults=tuple(args.inject_fault or ()),
     )
 
     # logfile parity: ./logs/{method}.log, append, message-only (reference

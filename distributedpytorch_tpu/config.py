@@ -131,6 +131,42 @@ class TrainConfig:
     # ranks stop together.
     early_stop_patience: int = 0
 
+    # -- resilience (utils/faults.py, docs/RELIABILITY.md) ------------------
+    # Policy when a train-step loss reads back non-finite (detection
+    # piggybacks the metrics readback — zero cost on healthy runs):
+    #   "abort"    raise NonFiniteLossError (default: fail loudly; under
+    #              fit_with_restarts / --max-restarts this already retries
+    #              from the last epoch checkpoint);
+    #   "rollback" reload the newest intact checkpoint in-place and redo
+    #              from its epoch, up to `rollback_retries` times, then
+    #              abort;
+    #   "skip"     check each step's loss synchronously (one device sync
+    #              per step — costs pipeline overlap; state donation is
+    #              disabled) and discard the update of any non-finite
+    #              step. Incompatible with fused dispatch / grad accum.
+    nonfinite_policy: str = "abort"
+    rollback_retries: int = 2
+    # Bounded exponential-backoff retries for transient host failures in
+    # the data decode path and the placement worker (OSError family):
+    # attempt i sleeps retry_backoff_s * 2**i. 0 retries = fail fast.
+    data_retries: int = 3
+    retry_backoff_s: float = 0.05
+    # Dispatch watchdog: a step-loop iteration exceeding this many seconds
+    # dumps the step-timeline tracer's per-phase spans and requests a
+    # checkpoint-and-stop via the collective stop agreement. 0 = off.
+    # The FIRST executed epoch is untimed (it compiles every executable
+    # shape — minutes over a tunneled runtime — which would false-fire
+    # any steady-state-sized timeout); coverage starts at epoch 2.
+    step_timeout_s: float = 0.0
+    # Checkpoint retention: keep the newest N files per checkpoint path
+    # (<tag>.ckpt, <tag>.ckpt.1, ...). Restore verifies each file's
+    # content hash and falls back to the newest intact one, so N >= 2
+    # makes a torn newest file recoverable. 1 = overwrite in place.
+    keep_checkpoints: int = 2
+    # Deterministic fault injection (tests / drills): "site:epoch:step
+    # [:count]" specs, sites in utils/faults.SITES. Empty = inert.
+    inject_faults: Tuple[str, ...] = ()
+
     # -- synthetic data (tests / benches without the Carvana download) ------
     synthetic_samples: int = 0  # >0: use an in-memory procedural dataset
 

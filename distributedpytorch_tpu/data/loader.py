@@ -22,12 +22,16 @@ machinery sized for a JAX trainer:
 from __future__ import annotations
 
 import dataclasses
+import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, Iterator, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributedpytorch_tpu.utils import faults
 from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
+
+logger = logging.getLogger(__name__)
 
 Batch = Dict[str, np.ndarray]
 
@@ -92,6 +96,8 @@ class DataLoader:
         num_workers: int = 0,
         cache=None,
         tracer=None,
+        max_retries: int = 0,
+        retry_backoff_s: float = 0.05,
     ):
         self.dataset = dataset
         self.indices = (
@@ -103,6 +109,11 @@ class DataLoader:
         self.seed = seed
         self.shard_spec = shard
         self.num_workers = int(num_workers)
+        # transient decode failures (OSError family: disk/network reads,
+        # PIL on torn files — and the injected `decode` fault) retry with
+        # bounded exponential backoff before surfacing (utils/faults.py)
+        self.max_retries = int(max_retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         # epoch-persistent decoded-sample cache (data/dataset.SampleCache),
         # shared across loaders of the same dataset (train + val): epochs
         # >= 2 serve whatever fit the budget from host memory, skipping
@@ -137,7 +148,22 @@ class DataLoader:
             order = rng.permutation(order)
         return self.shard_spec.shard(order)
 
-    def _load_batch(self, idx_list) -> Batch:
+    def _load_batch(self, idx_list, epoch: Optional[int] = None,
+                    batch_idx: Optional[int] = None) -> Batch:
+        """Assemble one batch with bounded-backoff retries on transient
+        failures; ``(epoch, batch_idx)`` (when the caller knows them) are
+        the `decode` fault-injection site's coordinates."""
+        return faults.call_with_retries(
+            lambda: self._assemble_batch(idx_list),
+            site="decode",
+            retries=self.max_retries,
+            backoff_s=self.retry_backoff_s,
+            epoch=epoch,
+            step=batch_idx,
+            log=logger,
+        )
+
+    def _assemble_batch(self, idx_list) -> Batch:
         """Assemble one batch, serving cached samples from host memory and
         decoding only the misses (traced as the pipeline's ``decode``
         phase — on a warm cache the span collapses to stack-only time)."""
@@ -222,8 +248,8 @@ class DataLoader:
     def epoch_batches(self, epoch: int = 0) -> Iterator[Batch]:
         slices = self.batch_slices(epoch)
         if self._pool is None:
-            for idx in slices:
-                yield self._load_batch(idx)
+            for i, idx in enumerate(slices):
+                yield self._load_batch(idx, epoch=epoch, batch_idx=i)
             return
 
         # Pipelined prefetch: keep up to 2 whole-batch futures in flight
@@ -231,7 +257,13 @@ class DataLoader:
         # bounded_submit cancels queued decodes if the consumer stops early.
         from distributedpytorch_tpu.utils.prefetch import bounded_submit
 
-        yield from bounded_submit(self._pool, self._load_batch, slices, depth=2)
+        def load(pair):
+            i, idx = pair
+            return self._load_batch(idx, epoch=epoch, batch_idx=i)
+
+        yield from bounded_submit(
+            self._pool, load, list(enumerate(slices)), depth=2
+        )
 
     def __iter__(self) -> Iterator[Batch]:
         return self.epoch_batches(0)

@@ -51,6 +51,26 @@ def _torchrun_env() -> Optional[RuntimeInfo]:
     return RuntimeInfo(rank, world, f"{addr}:{port}")
 
 
+def _enable_cpu_collectives() -> None:
+    """Give multi-process CPU runs a working collectives backend.
+
+    jaxlib's CPU client defaults to collectives 'none', so ANY
+    multiprocess computation — the DDP gradient all-reduce, the sharded
+    evaluator's grouped dispatch, `process_allgather` (both the stop
+    agreement and the FSDP checkpoint gather) — dies with "Multiprocess
+    computations aren't implemented on the CPU backend". Gloo ships in
+    jaxlib; it just has to be selected BEFORE the backend initializes.
+    Called only on the multi-process paths: single-process runs never
+    need it, and on TPU backends the flag is simply unread."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jax without the flag: leave as-is
+        logger.warning(
+            "could not enable gloo CPU collectives; multi-process CPU "
+            "computations may be unavailable", exc_info=True,
+        )
+
+
 def _warm_host_collectives() -> None:
     """Form the all-process host-collective (Gloo, on CPU backends) context
     NOW, while every rank is still in lockstep from `initialize()`'s
@@ -86,6 +106,7 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
     # flag) because on single-host and tunneled setups the detection probes
     # would stall startup.
     if os.environ.get("DPT_JAX_AUTO_INIT") == "1":
+        _enable_cpu_collectives()
         jax.distributed.initialize()
         _INITIALIZED = True
         info = RuntimeInfo(jax.process_index(), jax.process_count(), None)
@@ -111,6 +132,7 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
     if info is None or info.num_processes <= 1:
         return RuntimeInfo(0, 1, None)
 
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=info.coordinator,
         num_processes=info.num_processes,

@@ -41,7 +41,7 @@ def _prep_mask(mask: jax.Array) -> jax.Array:
     return mask[..., None].astype(jnp.float32)
 
 
-def _state_donation() -> tuple:
+def _state_donation(config: Optional[TrainConfig] = None) -> tuple:
     """``donate_argnums`` for the jitted train steps: donating the state
     halves HBM pressure on accelerators (in-place Adam update), but the
     jax 0.4.37 CPU client intermittently ABORTS (native SIGABRT/SIGSEGV,
@@ -49,7 +49,14 @@ def _state_donation() -> tuple:
     trainers run in one process — reproduced at ~40-50% on the restart
     tests (two Trainers per process) and ~10% on a plain resume, 0/15
     with donation off, seed code either way. CPU donation saves nothing
-    (buffers are host RAM regardless), so donate only off-CPU."""
+    (buffers are host RAM regardless), so donate only off-CPU.
+
+    ``nonfinite_policy='skip'`` also disables donation everywhere: the
+    trainer holds the PREVIOUS state across each step so a non-finite
+    step's update can be discarded — a donated previous state would be
+    deleted buffers (train/loop.py)."""
+    if config is not None and config.nonfinite_policy == "skip":
+        return ()
     return () if jax.default_backend() == "cpu" else (0,)
 
 
@@ -151,14 +158,14 @@ class Strategy:
         )
 
     def build_train_step(self, model, tx) -> Callable:
-        return jax.jit(self._raw_step(model, tx), donate_argnums=_state_donation())
+        return jax.jit(self._raw_step(model, tx), donate_argnums=_state_donation(self.config))
 
     def build_multi_train_step(self, model, tx) -> Callable:
         """K steps per dispatch: `multi(state, stacked) -> (state, losses)`
         with batches stacked on a leading axis (see make_multi_train_step;
         place the stacked batch with `place_stacked_batch`)."""
         multi = make_multi_train_step(self._raw_step(model, tx))
-        return jax.jit(multi, donate_argnums=_state_donation())
+        return jax.jit(multi, donate_argnums=_state_donation(self.config))
 
     def build_accum_train_step(self, model, tx) -> Callable:
         """ONE optimizer step over config.grad_accum stacked batches with
@@ -175,7 +182,7 @@ class Strategy:
             remat=self.config.remat,
             use_pallas=self.config.use_pallas and self.mesh is None,
         )
-        return jax.jit(step, donate_argnums=_state_donation())
+        return jax.jit(step, donate_argnums=_state_donation(self.config))
 
     def place_stacked_batch(
         self, stacked: Dict[str, np.ndarray]
@@ -256,9 +263,44 @@ class SingleDevice(Strategy):
     name = "singleGPU"
 
 
+def _coerce_leaf(x):
+    """Python scalars → numpy before placement: a restored checkpoint's
+    ``step`` counter is a plain int, which multi-process placement
+    rejects outright."""
+    return x if isinstance(x, (jax.Array, np.ndarray)) else np.asarray(x)
+
+
+def _place_global(x, sharding: NamedSharding):
+    """Place one leaf under a sharding that may span processes.
+
+    On a multi-process mesh, every locally-materializable value — host
+    numpy (the checkpoint-restore path) AND fully-addressable jax arrays
+    (fresh single-device init) — goes through
+    ``make_array_from_callback``: each process builds its own
+    addressable shards from its (identical by construction: same seed,
+    same checkpoint file) local copy, with NO cross-process transfer and
+    NO collective. ``device_put`` onto a non-addressable sharding
+    instead runs a gloo `assert_equal` allgather per leaf — a collective
+    per parameter at every trainer construction, observed crashing gloo
+    (`op.preamble.length <= op.nbytes`) when those host collectives
+    interleave with XLA's own CPU collectives. Single-process keeps
+    plain device_put.
+    """
+    x = _coerce_leaf(x)
+    if jax.process_count() > 1:
+        if isinstance(x, jax.Array):
+            if not x.is_fully_addressable:
+                return jax.device_put(x, sharding)  # already global
+            x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, sharding, lambda idx, v=x: v[idx]
+        )
+    return jax.device_put(x, sharding)
+
+
 def _replicate(mesh: Mesh, tree):
     sharding = NamedSharding(mesh, P())
-    return jax.device_put(tree, sharding)
+    return jax.tree.map(lambda x: _place_global(x, sharding), tree)
 
 
 class DataParallel(Strategy):
@@ -752,10 +794,11 @@ def _shard_state_by_rule(state, mesh: Mesh, leaf_spec, strategy_name: str) -> An
 
     def place(x):
         nonlocal sharded
+        x = _coerce_leaf(x)
         spec = leaf_spec(getattr(x, "shape", ()))
         if any(s is not None for s in spec):
             sharded += 1
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _place_global(x, NamedSharding(mesh, spec))
 
     placed = jax.tree.map(place, state)
     if sharded == 0:
@@ -819,7 +862,7 @@ class TensorParallel(Strategy):
         return self.place_batch(stacked)  # replicated either way
 
 
-class FullyShardedDataParallel(DataParallel):
+class FullyShardedDataParallel(MultiProcessMixin, DataParallel):
     """``-t FSDP``: ZeRO-3-style fully sharded data parallel — another
     capability the reference lacks (SURVEY.md §2: "FSDP/ZeRO — full
     replica per device").
@@ -830,9 +873,41 @@ class FullyShardedDataParallel(DataParallel):
     the forward/backward and the reduce-scatter of gradients — the ZeRO
     dance — from annotations alone. Per-chip state memory drops by the
     mesh size; compute matches DP.
+
+    Multi-process capable (ZeRO semantics, unlike torch-DP-shaped ``DP``):
+    the mesh spans EVERY process's devices and the MultiProcessMixin
+    contract applies — per-process batch (global = b × data rows), sample
+    sharding, process-local batch assembly. Sharded state on a pod is not
+    fully addressable on any one host; checkpointing allgathers each such
+    leaf collectively (checkpoint._to_host), which the 2-process
+    save/restore test in tests/test_multiprocess.py proves. The DDP lr ×
+    world quirk is NOT applied: FSDP is a memory layout, not the
+    reference's DDP recipe. Single-process behavior (mesh over the local
+    devices, with DP's shrink-to-divisor on indivisible batches) is
+    unchanged.
     """
 
     name = "FSDP"
+
+    def __init__(self, config: TrainConfig, devices=None):
+        if devices is not None or jax.process_count() == 1:
+            # single-process (or explicit devices): exactly DP's mesh,
+            # including the shrink-to-largest-divisor warning path
+            DataParallel.__init__(self, config, devices)
+            return
+        Strategy.__init__(self, config)
+        devs = list(jax.devices())
+        if (config.batch_size * jax.process_count()) % len(devs) != 0:
+            raise ValueError(
+                f"FSDP: global batch {config.batch_size} × "
+                f"{jax.process_count()} processes must divide the "
+                f"{len(devs)}-device mesh"
+            )
+        self.mesh = Mesh(np.array(devs), ("data",))
+        self.batch_sharding = NamedSharding(self.mesh, P("data"))
+
+    def lr_for(self, base_lr: float) -> float:
+        return base_lr
 
     def _leaf_spec(self, shape) -> P:
         size = self.mesh.shape["data"]

@@ -30,11 +30,21 @@ and checkpoint serialization+writes run on a background writer thread
 (checkpoint.save_checkpoint_async), drained before train() returns. Each
 phase is observable through the step-timeline tracer (utils/trace.py,
 ``--trace-timeline``).
+
+Resilience (docs/RELIABILITY.md): non-finite-loss policies riding the
+metrics readback (``abort`` / ``rollback``-to-checkpoint / ``skip``),
+bounded-backoff retries for transient decode/placement failures, a
+dispatch watchdog that dumps the step timeline and checkpoints-and-stops,
+and a deterministic fault-injection harness (utils/faults.py) proving
+each path. Checkpoint saves build their payload on EVERY rank (the host
+snapshot is a collective allgather when state is sharded across
+processes) with only the file write rank-0-gated.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import os
 import signal
@@ -59,6 +69,8 @@ from distributedpytorch_tpu.evaluate import evaluate, evaluate_sharded
 from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rate
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
+from distributedpytorch_tpu.utils import faults
+from distributedpytorch_tpu.utils.faults import NonFiniteLossError, StepWatchdog
 from distributedpytorch_tpu.utils.metrics import LossRecords
 from distributedpytorch_tpu.utils.prefetch import (
     pipelined_placement,
@@ -85,6 +97,20 @@ class Trainer:
         self.strategy = strategy or build_strategy(config)
         self.dataset = dataset if dataset is not None else self._build_dataset()
         self.rng = rng if rng is not None else jax.random.key(config.seed)
+        # arm the fault-injection harness (inert when no specs). install()
+        # is idempotent per spec list: fit_with_restarts rebuilds the
+        # Trainer after a crash and already-fired counts must survive.
+        faults.install(config.inject_faults)
+        if config.nonfinite_policy not in ("abort", "rollback", "skip"):
+            raise ValueError(
+                f"nonfinite_policy must be abort|rollback|skip, got "
+                f"{config.nonfinite_policy!r}"
+            )
+        # rollback budget for the non-finite-loss policy (counts down
+        # across the run; NOT reset per epoch — a persistently-NaN run
+        # must eventually abort)
+        self._rollback_budget = int(config.rollback_retries)
+        self._skipped_steps = 0
         # step-timeline tracer (utils/trace.py): disabled unless configured;
         # main process only — co-row processes would interleave one file
         self.tracer = StepTimeline(
@@ -162,6 +188,8 @@ class Trainer:
             num_workers=config.num_workers,
             cache=self.sample_cache,
             tracer=self.tracer,
+            max_retries=config.data_retries,
+            retry_backoff_s=config.retry_backoff_s,
         )
         # Val: drop_last=True (reference train_utils.py:42). The loader is
         # unsharded — batch formation is identical everywhere — but
@@ -179,6 +207,8 @@ class Trainer:
             drop_last=True,
             num_workers=config.num_workers,
             cache=self.sample_cache,
+            max_retries=config.data_retries,
+            retry_backoff_s=config.retry_backoff_s,
         )
 
         self.train_step = self.strategy.build_train_step(self.model, self.tx)
@@ -195,6 +225,14 @@ class Trainer:
             raise ValueError(
                 "--steps-per-dispatch and --grad-accum both stack loader "
                 "batches with conflicting step semantics — choose one"
+            )
+        if config.nonfinite_policy == "skip" and (
+            self.k_dispatch > 1 or self.grad_accum > 1
+        ):
+            raise ValueError(
+                "--nonfinite-policy skip discards one STEP's update, which "
+                "a fused dispatch / accumulated step cannot isolate — use "
+                "rollback or abort with --steps-per-dispatch/--grad-accum"
             )
         self.multi_step = (
             self.strategy.build_multi_train_step(self.model, self.tx)
@@ -218,6 +256,7 @@ class Trainer:
             config.loss_dir,
             every=config.metric_every_steps,
             tracer=self.tracer,
+            nonfinite_hook=self._on_nonfinite_loss,
         )
         if getattr(self, "_restored_records", None):
             # a resumed run appends to the run's metric history instead of
@@ -291,17 +330,58 @@ class Trainer:
         self._restored_records = restored.get("records")
         logger.info("Resumed from %s at epoch %d", path, self.start_epoch)
 
+    def _save_needs_all_ranks(self) -> bool:
+        """True iff the checkpoint snapshot is a COLLECTIVE: some state
+        leaf is sharded across processes (FSDP/TP pods), so every rank
+        must participate in its allgather. Replicated-state strategies
+        (DDP) answer False and non-main ranks skip the payload build
+        entirely — a full-tree device_get per epoch is seconds of pure
+        waste on a tunneled runtime. Identical on every rank (the
+        sharding layout is), so the skip cannot desync collectives;
+        memoized — the layout is fixed for the trainer's lifetime."""
+        cached = getattr(self, "_save_collective_memo", None)
+        if cached is not None:
+            return cached
+        if jax.process_count() == 1:
+            result = False
+        else:
+            from distributedpytorch_tpu.checkpoint import (
+                needs_collective_gather,
+            )
+
+            result = any(
+                needs_collective_gather(x)
+                for x in jax.tree.leaves(
+                    (self.state.params, self.state.opt_state,
+                     self.state.model_state)
+                )
+            )
+        self._save_collective_memo = result
+        return result
+
     def _save(self, epoch: int) -> None:
-        if not self.strategy.is_main or epoch == getattr(self, "_last_saved_epoch", None):
+        # dedup on EVERY rank (the decision is epoch-driven, identical
+        # everywhere). No blanket is_main gate: when state is sharded
+        # across processes the host snapshot inside the save is a
+        # COLLECTIVE allgather, so all ranks must reach it in lockstep —
+        # but for replicated state non-main ranks have nothing to
+        # contribute and skip the (expensive) payload build; the file
+        # write itself is always rank-0-gated (_save_tagged).
+        if epoch == getattr(self, "_last_saved_epoch", None):
             return
         self._last_saved_epoch = epoch
+        if not self.strategy.is_main and not self._save_needs_all_ranks():
+            return
         self._save_tagged(self._ckpt_path(), epoch)
 
     def _save_tagged(self, path: str, epoch: int) -> None:
         """One checkpoint save — async (host snapshot inline, serialize +
         write on the background writer) unless config.async_checkpoint is
-        off. Async futures are drained when train() ends, so the file is
-        durable before anything outside the run can read it."""
+        off. Every rank builds the payload (collective when sharded — see
+        _save); only the main process writes the file, retaining the
+        newest config.keep_checkpoints copies. Async futures are drained
+        when train() ends, so the file is durable before anything outside
+        the run can read it."""
         if self.config.async_checkpoint:
             # surface a failed EARLIER write now, not at the end of the
             # run (a disk-full at epoch 1 of 100 must not let 99 epochs
@@ -329,6 +409,8 @@ class Trainer:
             records_state=self.records.state_dict(),
             model_state=self.state.model_state,
             train_meta=self._train_meta(),
+            keep=self.config.keep_checkpoints,
+            write=self.strategy.is_main,
         )
         if fut is not None:
             self._ckpt_futures.append(fut)
@@ -355,6 +437,113 @@ class Trainer:
             "best_loss": self._best_loss,
             "stale_epochs": self._stale_epochs,
         }
+
+    # -- step-level failure policies (docs/RELIABILITY.md) -------------------
+    def _on_nonfinite_loss(self, step: int, value: float) -> None:
+        """LossRecords' readback hook: a train loss drained to host came
+        back NaN/Inf. Free on healthy runs — detection rides the drain
+        the metrics pipeline already does. ``skip`` handles non-finite
+        steps synchronously in the loop (run_one), so reaching this hook
+        under it only happens for paths skip cannot guard; log, don't
+        kill. ``abort``/``rollback`` raise — the epoch loop catches for
+        rollback, everything else propagates."""
+        if self.config.nonfinite_policy == "skip":
+            logger.warning(
+                "non-finite loss %s at step %d reached the metrics drain "
+                "under policy 'skip' (unguarded path) — continuing", value, step,
+            )
+            return
+        raise NonFiniteLossError(
+            f"non-finite train loss {value} at step {step} "
+            f"(policy={self.config.nonfinite_policy})"
+        )
+
+    def _try_rollback(self, exc: Exception) -> bool:
+        """``rollback`` policy: reload the newest intact checkpoint
+        in-place (state, scheduler, metric history, epoch) and let the
+        epoch loop redo from there. False = cannot roll back (wrong
+        policy, budget exhausted, or nothing to restore) — the caller
+        re-raises."""
+        cfg = self.config
+        if cfg.nonfinite_policy != "rollback":
+            return False
+        if jax.process_count() > 1:
+            # in-place rollback is single-process only, like
+            # fit_with_restarts' restarts: ranks would race rank 0's
+            # in-flight write/rotate (non-main ranks have no futures to
+            # drain) and could restore DIFFERENT epochs — divergent
+            # collective programs, deadlocked job. Abort instead; the
+            # launcher's restart loop re-rendezvouses all ranks against
+            # a settled checkpoint file.
+            logger.error(
+                "rollback policy is single-process; multi-process runs "
+                "abort and rely on the launcher's restart loop"
+            )
+            return False
+        if self._rollback_budget <= 0:
+            logger.error(
+                "rollback budget exhausted (%d rollbacks used) — aborting",
+                cfg.rollback_retries,
+            )
+            return False
+        # the checkpoint we are about to read may still be queued on the
+        # background writer — make it durable first
+        self._drain_checkpoint_futures(raise_errors=False)
+        path = self._ckpt_path()
+        from distributedpytorch_tpu.checkpoint import retained_checkpoints
+
+        # any retained candidate will do — load_checkpoint's fallback
+        # walks the chain, and a crash between rotate and rename can
+        # leave only `path.1` on disk with the live slot empty
+        if not retained_checkpoints(path):
+            logger.error("rollback requested but no checkpoint at %s", path)
+            return False
+        self._rollback_budget -= 1
+        logger.warning(
+            "%s — rolling back to %s (%d retries left)",
+            exc, path, self._rollback_budget,
+        )
+        self._restore(cfg.method_tag, self.state)
+        self.state = self.strategy.place_state(self._restored_state)
+        if self._restored_records:
+            self.records.load_state_dict(self._restored_records)
+        else:  # pre-records checkpoint: drop the poisoned history
+            self.records = LossRecords(
+                cfg.method_tag,
+                cfg.loss_dir,
+                every=cfg.metric_every_steps,
+                tracer=self.tracer,
+                nonfinite_hook=self._on_nonfinite_loss,
+            )
+        self._last_saved_epoch = None
+        return True
+
+    def _watchdog_timeout(self) -> None:
+        """StepWatchdog expiry (watchdog thread): dump the step-timeline
+        tracer's per-phase spans for diagnosis and request a
+        checkpoint-and-stop through the same collective agreement the
+        signal handler uses. Best-effort by nature — a host truly wedged
+        inside a native call cannot checkpoint; the dump is then the
+        run's last diagnostic."""
+        summary = {
+            k: v for k, v in self.tracer.summary().items() if v is not None
+        }
+        logger.error(
+            "dispatch watchdog: step loop made no progress for %.1fs — "
+            "requesting checkpoint-and-stop. Per-phase timeline: %s",
+            self.config.step_timeout_s,
+            json.dumps(summary) if summary else "(no spans recorded)",
+        )
+        recent = self.tracer.events()[-24:]
+        if recent:
+            logger.error("recent timeline spans: %s", json.dumps(recent))
+        elif not self.tracer.enabled:
+            logger.error(
+                "step-timeline tracing is off — run with --trace-timeline "
+                "to capture per-phase spans for watchdog diagnosis"
+            )
+        self.tracer.flush()
+        self._stop_requested = True
 
     # ------------------------------------------------------------------
     def _record(self, loss, n_imgs: int, global_step: int, pbar) -> None:
@@ -426,10 +615,15 @@ class Trainer:
             return result
         finally:
             self._restore_signal_handler()
+            if getattr(self, "_watchdog", None) is not None:
+                self._watchdog.stop()
             # flush BEFORE draining checkpoints: a failed write raises out
             # of the drain, and the final epoch's timeline spans are most
             # valuable exactly when diagnosing that failing run
             self.tracer.flush()
+            # the final drain is a HARD error boundary on a clean run: a
+            # failed write of the LAST save has no "next save" to surface
+            # it, so it must raise here, out of train() itself
             self._drain_checkpoint_futures(raise_errors=ok)
 
     def _run(self) -> dict:
@@ -452,206 +646,290 @@ class Trainer:
         val_loss = float("nan")
         val_dice = float("nan")
         stopped_early = False
-        for epoch in range(self.start_epoch, cfg.epochs):
-            # tqdm parity (reference train_utils.py:57): per-epoch image bar,
-            # main process only. Postfix shows the mean-of-last-10 row loss —
-            # NOT the per-step loss, which would force a device sync per step.
-            # exact images this epoch will yield: drop_last trims the ragged
-            # tail, otherwise every shard sample appears exactly once
-            with tqdm(
-                total=min(n_train, len(self.train_loader) * cfg.batch_size),
-                desc=f"Epoch {epoch + 1}/{cfg.epochs}",
-                unit="img",
-                disable=not self.strategy.is_main,
-                leave=False,
-            ) as pbar:
-                def run_one(batch, placed=None):
-                    nonlocal global_step
-                    n_imgs = batch["image"].shape[0]
-                    if placed is None:
-                        placed = self.strategy.place_batch(batch)
-                    with self.tracer.span("dispatch", step=global_step + 1):
-                        self.state, loss = self.train_step(self.state, placed)
-                    global_step += 1
-                    # loss stays a device scalar; LossRecords drains it to
-                    # host only at the next row/flush boundary
-                    self._record(loss, n_imgs, global_step, pbar)
-
-                def run_stack(buffered, placed):
-                    nonlocal global_step
-                    with self.tracer.span(
-                        "dispatch", step=global_step + 1, k=len(buffered)
-                    ):
-                        self.state, losses = self.multi_step(self.state, placed)
-                    # ONE memoized device→host pull for the whole (K,) loss
-                    # array, and only when a metrics row actually needs it —
-                    # slicing losses[i] here would issue K extra dispatches
-                    # and forfeit the amortization this path exists for.
-                    memo = {}
-
-                    def lazy(i):
-                        def pull():
-                            if "host" not in memo:
-                                memo["host"] = np.asarray(losses)
-                            return memo["host"][i]
-
-                        # LossRecords' non-blocking drain starts an async
-                        # host copy when a row is parked; expose the (K,)
-                        # array's hook so the fused-dispatch path gets the
-                        # same early D2H streaming as plain device scalars
-                        pull.copy_to_host_async = losses.copy_to_host_async
-                        return pull
-
-                    for i, b in enumerate(buffered):
-                        global_step += 1
-                        self._record(lazy(i), b["image"].shape[0], global_step, pbar)
-
-                def run_accum(buffered, placed):
-                    # ONE optimizer step over the K stacked batches —
-                    # effective batch K·b, exact loss (make_accum_train_step)
-                    nonlocal global_step
-                    with self.tracer.span(
-                        "dispatch", step=global_step + 1, k=len(buffered)
-                    ):
-                        self.state, loss = self.accum_step(self.state, placed)
-                    global_step += 1
-                    self._record(
-                        loss,
-                        sum(b["image"].shape[0] for b in buffered),
-                        global_step,
-                        pbar,
-                    )
-
-                stacking = self.multi_step is not None or self.accum_step is not None
-                stack_size = (
-                    self.k_dispatch if self.multi_step is not None else self.grad_accum
-                )
-                run_buffered = (
-                    run_stack if self.multi_step is not None else run_accum
-                )
-                single_process = jax.process_count() == 1
-                # The async step pipeline (utils/prefetch.py): the epoch's
-                # batch stream becomes SINGLE/STACK work items whose
-                # np.stack + device placement run on the prefetch worker,
-                # `prefetch_batches` payloads ahead of this loop — batch
-                # N+1's H2D rides under batch N's executing dispatch. Depth
-                # 0 degrades to inline placement (the synchronous baseline;
-                # identical loss sequence either way).
-                source = pipelined_placement(
-                    stacked_work(
-                        self.train_loader.epoch_batches(epoch),
-                        stack_size if stacking else 1,
-                        cfg.batch_size,
-                    ),
-                    self.strategy.place_work,
-                    depth=cfg.prefetch_batches,
-                    tracer=self.tracer,
-                )
-                # closing(): breaking out mid-epoch (signal stop) must CLOSE
-                # the pipeline generator so its worker stops and queued
-                # device-placed payloads get released — GC-time cleanup would
-                # keep them pinned through the checkpoint save. Work items
-                # past the stop (including a partial group's drained
-                # singles) are simply never stepped: they were never
-                # trained, so skipping them loses nothing, and a preemption
-                # grace window may be ticking.
-                with contextlib.closing(source):
-                    for (kind, payload), placed in source:
-                        # mid-epoch stop is single-process only: in
-                        # multi-process runs ranks must agree (epoch
-                        # boundary) or collectives desync and hang — see
-                        # _install_signal_handler
-                        if self._stop_requested and single_process:
-                            break
-                        if kind == "single":
-                            run_one(payload, placed)
-                        else:
-                            run_buffered(payload, placed)
-
-            if self._stop_agreed():
-                # save a resumable snapshot at the last COMPLETED epoch —
-                # resume redoes the interrupted epoch from its start (the
-                # dedup guard is cleared: mid-epoch params/opt state are
-                # newer than the end-of-previous-epoch save of same index)
-                self._last_saved_epoch = None
-                self._save(epoch)
-                logger.info(
-                    "Stopped by signal at epoch %d step %d; checkpoint saved",
-                    epoch + 1,
-                    global_step,
-                )
-                break
-
-            if self.grouped_eval_step is not None:
-                val_loss, val_dice = evaluate_sharded(
-                    self.eval_step,
-                    self.grouped_eval_step,
-                    self._eval_variables(),
-                    self.val_loader,
-                    self.strategy.place_batch,
-                    self.strategy.eval_shard(),
-                    progress=self.strategy.is_main,
-                )
-            else:
-                val_loss, val_dice = evaluate(
-                    self.eval_step,
-                    self._eval_variables(),
-                    self.val_loader,
-                    self.strategy.place_batch,
-                    progress=self.strategy.is_main,
-                )
-            self.records.record_val(global_step, val_loss, val_dice)
-            new_lr = self.scheduler.step(val_loss)
-            # float32 state vs python float: compare with tolerance
-            if not np.isclose(new_lr, get_learning_rate(self.state.opt_state), rtol=1e-6):
-                logger.info("Epoch %d: plateau → lr %.3e", epoch + 1, new_lr)
-                self.state = self.state.replace(
-                    opt_state=set_learning_rate(self.state.opt_state, new_lr)
-                )
-            logger.info(
-                "Epoch %d/%d: val loss %.4f, val dice %.4f (%.1f imgs/s)",
-                epoch + 1,
-                cfg.epochs,
-                val_loss,
-                val_dice,
-                self.records.images_per_second(),
+        skip_guard = cfg.nonfinite_policy == "skip"
+        # dispatch watchdog (docs/RELIABILITY.md): armed per step-loop
+        # iteration, paused across the non-step phases (eval, end-of-epoch
+        # checkpointing) whose duration is unrelated to step health;
+        # stopped in train()'s finally
+        self._watchdog = None
+        if cfg.step_timeout_s > 0:
+            self._watchdog = StepWatchdog(
+                cfg.step_timeout_s, self._watchdog_timeout
             )
-            # append this epoch's timeline spans (no-op when tracing is off)
-            self.tracer.flush()
-            if (
-                cfg.save_best
-                and self.strategy.is_main
-                and val_dice > self._best_dice
-            ):
-                self._best_dice = val_dice
-                self._save_tagged(
-                    self._ckpt_path(f"{cfg.method_tag}_best"), epoch + 1
-                )
-                logger.info(
-                    "New best val Dice %.4f at epoch %d → %s",
-                    val_dice, epoch + 1, self._ckpt_path(f"{cfg.method_tag}_best"),
-                )
-            if cfg.checkpoint_every_epochs and (
-                (epoch + 1) % cfg.checkpoint_every_epochs == 0
-            ):
-                self._save(epoch + 1)
-            if cfg.early_stop_patience:
-                # NaN val loss (empty split) never counts as improvement —
-                # patience running out on no-signal epochs is deliberate
-                if val_loss < self._best_loss:
-                    self._best_loss = val_loss
-                    self._stale_epochs = 0
-                else:
-                    self._stale_epochs += 1
-                    if self._stale_epochs >= cfg.early_stop_patience:
-                        logger.info(
-                            "Early stop at epoch %d: val loss has not "
-                            "improved for %d epochs (best %.4f)",
-                            epoch + 1, self._stale_epochs, self._best_loss,
+            self._watchdog.start()
+        watchdog = self._watchdog
+        # while, not for: the rollback policy rewinds `epoch` to the
+        # restored checkpoint mid-run (NonFiniteLossError handler below).
+        # `untimed_epoch` pins the FIRST executed epoch (where every
+        # executable shape compiles) for the watchdog exemption — it
+        # deliberately does NOT follow a rollback's start_epoch rewind:
+        # redone epochs run on warm executables and stay watched.
+        epoch = self.start_epoch
+        untimed_epoch = self.start_epoch
+        while epoch < cfg.epochs:
+            try:
+                # tqdm parity (reference train_utils.py:57): per-epoch image
+                # bar, main process only. Postfix shows the mean-of-last-10
+                # row loss — NOT the per-step loss, which would force a
+                # device sync per step. exact images this epoch will yield:
+                # drop_last trims the ragged tail, otherwise every shard
+                # sample appears exactly once
+                with tqdm(
+                    total=min(n_train, len(self.train_loader) * cfg.batch_size),
+                    desc=f"Epoch {epoch + 1}/{cfg.epochs}",
+                    unit="img",
+                    disable=not self.strategy.is_main,
+                    leave=False,
+                ) as pbar:
+                    def run_one(batch, placed=None):
+                        nonlocal global_step
+                        n_imgs = batch["image"].shape[0]
+                        if placed is None:
+                            placed = self.strategy.place_batch(batch)
+                        # policy 'skip' holds the pre-step state so a
+                        # non-finite step's update can be discarded
+                        # (donation is off under it — _state_donation)
+                        prev_state = self.state if skip_guard else None
+                        with self.tracer.span("dispatch", step=global_step + 1):
+                            self.state, loss = self.train_step(self.state, placed)
+                        if faults.fire("nan_loss", epoch=epoch,
+                                       step=global_step + 1):
+                            loss = float("nan")  # forced step output
+                        if skip_guard and not np.isfinite(float(loss)):
+                            # the one host sync per step this policy costs
+                            self._skipped_steps += 1
+                            logger.warning(
+                                "non-finite loss at step %d: update "
+                                "discarded (%d skipped so far)",
+                                global_step + 1, self._skipped_steps,
+                            )
+                            self.state = prev_state
+                            return
+                        global_step += 1
+                        # loss stays a device scalar; LossRecords drains it
+                        # to host only at the next row/flush boundary
+                        self._record(loss, n_imgs, global_step, pbar)
+
+                    def run_stack(buffered, placed):
+                        nonlocal global_step
+                        with self.tracer.span(
+                            "dispatch", step=global_step + 1, k=len(buffered)
+                        ):
+                            self.state, losses = self.multi_step(self.state, placed)
+                        # ONE memoized device→host pull for the whole (K,)
+                        # loss array, and only when a metrics row actually
+                        # needs it — slicing losses[i] here would issue K
+                        # extra dispatches and forfeit the amortization
+                        # this path exists for.
+                        memo = {}
+
+                        def lazy(i):
+                            def pull():
+                                if "host" not in memo:
+                                    memo["host"] = np.asarray(losses)
+                                return memo["host"][i]
+
+                            # LossRecords' non-blocking drain starts an
+                            # async host copy when a row is parked; expose
+                            # the (K,) array's hook so the fused-dispatch
+                            # path gets the same early D2H streaming as
+                            # plain device scalars
+                            pull.copy_to_host_async = losses.copy_to_host_async
+                            return pull
+
+                        for i, b in enumerate(buffered):
+                            global_step += 1
+                            self._record(lazy(i), b["image"].shape[0], global_step, pbar)
+
+                    def run_accum(buffered, placed):
+                        # ONE optimizer step over the K stacked batches —
+                        # effective batch K·b, exact loss (make_accum_train_step)
+                        nonlocal global_step
+                        with self.tracer.span(
+                            "dispatch", step=global_step + 1, k=len(buffered)
+                        ):
+                            self.state, loss = self.accum_step(self.state, placed)
+                        global_step += 1
+                        self._record(
+                            loss,
+                            sum(b["image"].shape[0] for b in buffered),
+                            global_step,
+                            pbar,
                         )
-                        stopped_early = True
-                        self._save(epoch + 1)
-                        break
+
+                    stacking = self.multi_step is not None or self.accum_step is not None
+                    stack_size = (
+                        self.k_dispatch if self.multi_step is not None else self.grad_accum
+                    )
+                    run_buffered = (
+                        run_stack if self.multi_step is not None else run_accum
+                    )
+                    single_process = jax.process_count() == 1
+                    # The async step pipeline (utils/prefetch.py): the
+                    # epoch's batch stream becomes SINGLE/STACK work items
+                    # whose np.stack + device placement run on the prefetch
+                    # worker, `prefetch_batches` payloads ahead of this
+                    # loop — batch N+1's H2D rides under batch N's
+                    # executing dispatch. Depth 0 degrades to inline
+                    # placement (the synchronous baseline; identical loss
+                    # sequence either way).
+                    source = pipelined_placement(
+                        stacked_work(
+                            self.train_loader.epoch_batches(epoch),
+                            stack_size if stacking else 1,
+                            cfg.batch_size,
+                        ),
+                        self.strategy.place_work,
+                        depth=cfg.prefetch_batches,
+                        tracer=self.tracer,
+                        epoch=epoch,
+                        max_retries=cfg.data_retries,
+                        retry_backoff_s=cfg.retry_backoff_s,
+                    )
+                    # closing(): breaking out mid-epoch (signal stop) must
+                    # CLOSE the pipeline generator so its worker stops and
+                    # queued device-placed payloads get released — GC-time
+                    # cleanup would keep them pinned through the checkpoint
+                    # save. Work items past the stop (including a partial
+                    # group's drained singles) are simply never stepped:
+                    # they were never trained, so skipping them loses
+                    # nothing, and a preemption grace window may be ticking.
+                    with contextlib.closing(source):
+                        for (kind, payload), placed in source:
+                            if watchdog is not None:
+                                if epoch == untimed_epoch:
+                                    # the first executed epoch compiles
+                                    # every executable shape (initial
+                                    # step, K-stack, ragged tail) —
+                                    # minutes on a tunneled runtime; an
+                                    # armed deadline here would fire on
+                                    # a healthy compile. Untimed by
+                                    # design; steady-state epochs arm.
+                                    watchdog.pause()
+                                else:
+                                    watchdog.pet()
+                            # mid-epoch stop is single-process only: in
+                            # multi-process runs ranks must agree (epoch
+                            # boundary) or collectives desync and hang —
+                            # see _install_signal_handler
+                            if self._stop_requested and single_process:
+                                break
+                            if kind == "single":
+                                run_one(payload, placed)
+                            else:
+                                run_buffered(payload, placed)
+                            # simulated preemption: deliver a real SIGTERM
+                            # through the installed handler so the drill
+                            # exercises the production stop path
+                            if faults.fire("sigterm", epoch=epoch,
+                                           step=global_step):
+                                signal.raise_signal(signal.SIGTERM)
+                if watchdog is not None:
+                    watchdog.pause()
+
+                if self._stop_agreed():
+                    # save a resumable snapshot at the last COMPLETED epoch
+                    # — resume redoes the interrupted epoch from its start
+                    # (the dedup guard is cleared: mid-epoch params/opt
+                    # state are newer than the end-of-previous-epoch save
+                    # of same index)
+                    self._last_saved_epoch = None
+                    self._save(epoch)
+                    logger.info(
+                        "Stopped by signal at epoch %d step %d; checkpoint saved",
+                        epoch + 1,
+                        global_step,
+                    )
+                    break
+
+                if self.grouped_eval_step is not None:
+                    val_loss, val_dice = evaluate_sharded(
+                        self.eval_step,
+                        self.grouped_eval_step,
+                        self._eval_variables(),
+                        self.val_loader,
+                        self.strategy.place_batch,
+                        self.strategy.eval_shard(),
+                        progress=self.strategy.is_main,
+                    )
+                else:
+                    val_loss, val_dice = evaluate(
+                        self.eval_step,
+                        self._eval_variables(),
+                        self.val_loader,
+                        self.strategy.place_batch,
+                        progress=self.strategy.is_main,
+                    )
+                self.records.record_val(global_step, val_loss, val_dice)
+                new_lr = self.scheduler.step(val_loss)
+                # float32 state vs python float: compare with tolerance
+                if not np.isclose(new_lr, get_learning_rate(self.state.opt_state), rtol=1e-6):
+                    logger.info("Epoch %d: plateau → lr %.3e", epoch + 1, new_lr)
+                    self.state = self.state.replace(
+                        opt_state=set_learning_rate(self.state.opt_state, new_lr)
+                    )
+                logger.info(
+                    "Epoch %d/%d: val loss %.4f, val dice %.4f (%.1f imgs/s)",
+                    epoch + 1,
+                    cfg.epochs,
+                    val_loss,
+                    val_dice,
+                    self.records.images_per_second(),
+                )
+                # append this epoch's timeline spans (no-op when tracing is off)
+                self.tracer.flush()
+                # no is_main gate: val_dice is identical on every rank, so
+                # all ranks take this branch together — the payload build
+                # inside _save_tagged is collective on sharded state, and
+                # the file write is rank-0-gated there
+                if cfg.save_best and val_dice > self._best_dice:
+                    self._best_dice = val_dice
+                    if self.strategy.is_main or self._save_needs_all_ranks():
+                        self._save_tagged(
+                            self._ckpt_path(f"{cfg.method_tag}_best"), epoch + 1
+                        )
+                    logger.info(
+                        "New best val Dice %.4f at epoch %d → %s",
+                        val_dice, epoch + 1, self._ckpt_path(f"{cfg.method_tag}_best"),
+                    )
+                if cfg.checkpoint_every_epochs and (
+                    (epoch + 1) % cfg.checkpoint_every_epochs == 0
+                ):
+                    self._save(epoch + 1)
+                if cfg.early_stop_patience:
+                    # NaN val loss (empty split) never counts as improvement
+                    # — patience running out on no-signal epochs is
+                    # deliberate
+                    if val_loss < self._best_loss:
+                        self._best_loss = val_loss
+                        self._stale_epochs = 0
+                    else:
+                        self._stale_epochs += 1
+                        if self._stale_epochs >= cfg.early_stop_patience:
+                            logger.info(
+                                "Early stop at epoch %d: val loss has not "
+                                "improved for %d epochs (best %.4f)",
+                                epoch + 1, self._stale_epochs, self._best_loss,
+                            )
+                            stopped_early = True
+                            self._save(epoch + 1)
+                            break
+            except NonFiniteLossError as exc:
+                # the 'rollback' policy: reload the newest intact
+                # checkpoint and redo from its epoch (bounded budget —
+                # _try_rollback returns False when exhausted and the
+                # error propagates like 'abort'). Park the watchdog
+                # first: the drain+restore below is not a step, and its
+                # duration must not fire a stop that defeats the
+                # recovery (it re-arms at the redone epoch's first pet)
+                if watchdog is not None:
+                    watchdog.pause()
+                if not self._try_rollback(exc):
+                    raise
+                epoch = self.start_epoch  # _restore rewound it
+                global_step = int(self.state.step)
+                continue
+            epoch += 1
 
         if cfg.profile_dir and self.strategy.is_main:
             jax.profiler.stop_trace()
@@ -676,6 +954,10 @@ class Trainer:
             "steps": global_step,
             "images_per_second": self.records.images_per_second(),
             "n_train": n_train,
+            # resilience accounting (docs/RELIABILITY.md): updates
+            # discarded by policy 'skip' and rollbacks consumed
+            "skipped_steps": self._skipped_steps,
+            "rollbacks": self.config.rollback_retries - self._rollback_budget,
         }
 
 

@@ -53,11 +53,18 @@ class LossRecords:
         loss_dir: str = "./loss",
         every: int = 10,
         tracer=None,
+        nonfinite_hook=None,
     ):
         self.method_tag = method_tag
         self.loss_dir = loss_dir
         self.every = every
         self.tracer = tracer or NULL_TIMELINE
+        # non-finite loss detection piggybacked on the readback (the drain
+        # already materializes every loss to a host float — checking it is
+        # free): called as hook(step, value) on the first non-finite value
+        # of each drained window. The trainer's failure policies hang off
+        # this (train/loop.py); None = no detection (standalone users).
+        self.nonfinite_hook = nonfinite_hook
         self.start_time = time.time()
         self.losses: List[float] = []
         self.train_rows: List[list] = []  # [step, time_s, mean-of-last-10 loss]
@@ -115,6 +122,14 @@ class LossRecords:
                 ]
                 self.losses[lo:hi] = window
                 self.train_rows.append([step, ts, float(np.mean(window))])
+                if self.nonfinite_hook is not None:
+                    for v in window:
+                        if not np.isfinite(v):
+                            # the hook may raise (abort/rollback policies);
+                            # this row is already appended, so the curve
+                            # shows WHERE the run went non-finite
+                            self.nonfinite_hook(step, v)
+                            break
 
     def state_dict(self) -> dict:
         """Serializable metric history for checkpointing (msgpack-plain:
@@ -123,6 +138,15 @@ class LossRecords:
         self.drain()
         window = [float(x() if callable(x) else x) for x in self.losses]
         self.losses[:] = window
+        if self.nonfinite_hook is not None:
+            # the sub-window since the last due row is only ever forced
+            # HERE (drain checks whole rows): without this, a NaN landing
+            # between row boundaries would be checkpointed as healthy
+            # state and detection would miss it entirely
+            for v in window[-self.every:]:
+                if not np.isfinite(v):
+                    self.nonfinite_hook(len(window), v)
+                    break
         return {
             "train_rows": [list(map(float, r)) for r in self.train_rows],
             "val_rows": [list(map(float, r)) for r in self.val_rows],

@@ -29,13 +29,17 @@ instead of serializing behind it.
 from __future__ import annotations
 
 import collections
+import logging
 import queue as queue_mod
 import threading
-from typing import Callable, Iterable, Iterator, Tuple, TypeVar
+from typing import Callable, Iterable, Iterator, Optional, Tuple, TypeVar
 
 import numpy as np
 
+from distributedpytorch_tpu.utils import faults
 from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
+
+logger = logging.getLogger(__name__)
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -141,6 +145,9 @@ def pipelined_placement(
     place_fn: Callable[[str, object], object],
     depth: int = 2,
     tracer=None,
+    epoch: Optional[int] = None,
+    max_retries: int = 0,
+    retry_backoff_s: float = 0.05,
 ) -> Iterator[Tuple[Tuple[str, object], object]]:
     """Yield ``(work_item, placed)`` with stacking + H2D placement running
     up to ``depth`` items ahead on the prefetch worker.
@@ -151,6 +158,11 @@ def pipelined_placement(
     then placed as one (K, B, ...) payload. ``depth <= 0`` places inline
     on the consumer thread (the synchronous baseline; still traced), as a
     generator so ``contextlib.closing`` works identically either way.
+
+    Transient placement failures (OSError family — a flapping runtime
+    channel — and the injected ``placement`` fault, coordinates
+    ``(epoch, seq)``) retry with bounded exponential backoff before the
+    worker surfaces them (utils/faults.py).
 
     The ``stack``/``h2d`` tracer spans recorded here are what make the
     overlap observable: their wall-clock windows interleave with the
@@ -170,7 +182,15 @@ def pipelined_placement(
                     for key in payload[0]
                 }
         with tracer.span("h2d", seq=seq, kind=kind):
-            return place_fn(kind, payload)
+            return faults.call_with_retries(
+                lambda: place_fn(kind, payload),
+                site="placement",
+                retries=max_retries,
+                backoff_s=retry_backoff_s,
+                epoch=epoch,
+                step=seq,
+                log=logger,
+            )
 
     if depth <= 0:
         return ((item, place(item)) for item in work)

@@ -325,7 +325,7 @@ class TestAsyncCheckpoint:
         import distributedpytorch_tpu.checkpoint as ckpt_mod
         import distributedpytorch_tpu.train.loop as loop_mod
 
-        def bad_write(path, payload):
+        def bad_write(path, payload, keep=1):
             raise OSError("disk full")
 
         monkeypatch.setattr(ckpt_mod, "_write_payload", bad_write)
@@ -335,6 +335,30 @@ class TestAsyncCheckpoint:
         cfg = _config(tmp_path, epochs=1)
         with pytest.raises(OSError, match="disk full"):
             loop_mod.Trainer(cfg).train()
+
+    def test_last_save_failure_surfaces_at_final_drain(self, tmp_path,
+                                                       monkeypatch):
+        """A write failure on the FINAL save has no 'next save' to surface
+        it — the drain in train()'s finally is the only boundary left and
+        must raise it as a hard error (earlier saves all succeed, so this
+        pins the final-drain path specifically, not the surface-at-next-
+        save path)."""
+        import distributedpytorch_tpu.checkpoint as ckpt_mod
+
+        real_write = ckpt_mod._write_payload
+        calls = {"n": 0}
+
+        def fail_final_only(path, payload, keep=1):
+            calls["n"] += 1
+            if payload["epoch"] >= 2:  # only the end-of-run save fails
+                raise OSError("disk full on the final save")
+            return real_write(path, payload, keep=keep)
+
+        monkeypatch.setattr(ckpt_mod, "_write_payload", fail_final_only)
+        cfg = _config(tmp_path, epochs=2, checkpoint_every_epochs=0)
+        with pytest.raises(OSError, match="final save"):
+            Trainer(cfg).train()
+        assert calls["n"] >= 1
 
     def test_sync_mode_still_works(self, tmp_path):
         from distributedpytorch_tpu.checkpoint import load_checkpoint

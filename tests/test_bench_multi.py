@@ -148,23 +148,70 @@ class TestMainLoop:
 
     def test_runtime_death_stops_sequence_innocent(
             self, tmp_path, monkeypatch):
-        """A RuntimeError mid-sequence whose liveness re-probe FAILS
-        marks that config innocent (retryable next window) and stops —
-        later configs stay unattempted, so the program exits nonzero
-        and the watcher re-fires."""
+        """A RuntimeError mid-sequence whose liveness probe AND every
+        backed-off re-probe fail marks that config innocent (retryable
+        next window) and stops — later configs stay unattempted, so the
+        program exits nonzero and the watcher re-fires."""
         out = str(tmp_path / "m.jsonl")
         configs = [("a", {}, 60.0), ("b", {}, 60.0), ("c", {}, 60.0)]
         mod = self._fake_bench(
             [{"value": 1.0}, RuntimeError("UNAVAILABLE: relay gone")])
+        dead = {"ok": False, "error": "probe timeout"}
         self._patch(monkeypatch, tmp_path, True, mod, configs, probes=[
             {"ok": True, "platform": "tpu"},   # session start
-            {"ok": False, "error": "probe timeout"},  # after the raise
+            dead,                              # after the raise
+            # the exponential-backoff re-probes, all dead
+            dead, dead, dead, dead,
         ])
+        sleeps = []
+        monkeypatch.setattr(bench_multi.time, "sleep", sleeps.append)
         rc = bench_multi.main(["--out", out])
         assert rc == 4
+        # backoff actually backed off: 5, 10, 20 between re-probes
+        assert sleeps == [5.0, 10.0, 20.0]
         state = bench_multi.load_state(out)
         assert state == {"a": "ok", "b": "innocent"}
         assert "c" not in state
+
+    def test_flapping_runtime_recovers_and_continues(
+            self, tmp_path, monkeypatch):
+        """THE r05 window-burner: a runtime that answers dead right after
+        a config failure but comes back during the backed-off re-probes.
+        The failed config is innocent (retried next invocation) and the
+        SEQUENCE CONTINUES — the window is not returned."""
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0), ("b", {}, 60.0), ("c", {}, 60.0)]
+        mod = self._fake_bench(
+            [{"value": 1.0}, RuntimeError("UNAVAILABLE: relay gone"),
+             {"value": 3.0}])
+        dead = {"ok": False, "error": "probe timeout"}
+        alive = {"ok": True, "platform": "tpu"}
+        self._patch(monkeypatch, tmp_path, True, mod, configs, probes=[
+            alive,        # session start
+            dead,         # after the raise
+            dead, alive,  # backoff re-probes: flap ends
+        ])
+        monkeypatch.setattr(bench_multi.time, "sleep", lambda s: None)
+        rc = bench_multi.main(["--out", out])
+        state = bench_multi.load_state(out)
+        assert state == {"a": "ok", "b": "innocent", "c": "ok"}
+        assert rc == 1  # b remains unmeasured → refire
+
+    def test_channel_blip_with_live_runtime_is_innocent(
+            self, tmp_path, monkeypatch):
+        """A channel-shaped error (UNAVAILABLE/connection/...) while the
+        probe still answers: the in-process client blipped — the config
+        must stay retryable (innocent), NOT be poisoned as permanent."""
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0), ("b", {}, 60.0)]
+        mod = self._fake_bench(
+            [RuntimeError("UNAVAILABLE: socket closed mid-dispatch"),
+             {"value": 2.0}])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(["--out", out])
+        assert bench_multi.load_state(out) == {
+            "a": "innocent", "b": "ok"}
+        assert rc == 1  # a remains unmeasured → refire
 
     def test_runtime_error_with_live_runtime_is_permanent(
             self, tmp_path, monkeypatch):
@@ -209,6 +256,21 @@ class TestMainLoop:
         mod = self._fake_bench([])
         self._patch(monkeypatch, tmp_path, True, mod, configs)
         assert bench_multi.main(["--out", out]) == 0
+
+    def test_compile_only_probe_config(self):
+        """The 30 s wgrad_pallas compile-only probe (VERDICT r05 next-8)
+        sits AHEAD of the full taps legs and carries the compile-only
+        lever, so a Mosaic rejection is learned before a 2700 s budget
+        is committed."""
+        names = [n for n, _, _ in bench_multi.CONFIGS]
+        probe_i = names.index("wgrad_pallas_probe")
+        assert probe_i < names.index("wgrad_taps")
+        assert probe_i < names.index("wgrad_taps_pallas")
+        _, env, budget = bench_multi.CONFIGS[probe_i]
+        assert budget == 30.0
+        assert env["BENCH_COMPILE_ONLY"] == "1"
+        assert env["DPT_WGRAD_BACKEND"] == "pallas"
+        assert "BENCH_COMPILE_ONLY" in bench_multi._CONFIG_ENV_KEYS
 
     def test_run_one_sets_module_config(self, monkeypatch):
         """_run_one must re-derive bench's module globals per config —

@@ -315,11 +315,16 @@ class TestStrategySteps:
     def test_tp_fsdp_state_actually_sharded(self, model, params, batch):
         """TP shards out-channels over 'model'; FSDP shards each leaf's
         largest axis over 'data' — verify per-device shards are smaller
-        than the leaf (the memory claim, not just numerics)."""
+        than the leaf AND that per-device buffer bytes over the WHOLE
+        state (params + Adam) land near total/mesh, not near the
+        replicated baseline of total (VERDICT r05 next-6: a silent
+        replication regression passes the single-leaf check but not
+        this one)."""
         import jax as _jax
 
         from distributedpytorch_tpu.train.steps import create_train_state
 
+        mesh_size = 8  # the virtual CPU mesh (conftest)
         for method, axis in [("TP", "model"), ("FSDP", "data")]:
             strat = build_strategy(_config(method))
             state, _ = create_train_state(
@@ -334,6 +339,27 @@ class TestStrategySteps:
             shard = next(iter(big.addressable_shards))
             assert shard.data.size < big.size, (
                 f"{method}: params not actually sharded"
+            )
+            # per-device accounting: sum every leaf's shard bytes per
+            # device. Replicated baseline = every device holds `total`;
+            # honest sharding ≈ total/mesh (+ the small replicated
+            # residue: scalars, the Cout=1 segmap head, tiny biases).
+            total = 0
+            per_dev = {}
+            for leaf in _jax.tree.leaves(placed):
+                if not hasattr(leaf, "addressable_shards"):
+                    continue
+                total += leaf.size * leaf.dtype.itemsize
+                for sh in leaf.addressable_shards:
+                    per_dev[sh.device] = (
+                        per_dev.get(sh.device, 0)
+                        + sh.data.size * sh.data.dtype.itemsize
+                    )
+            assert len(per_dev) == mesh_size
+            worst = max(per_dev.values())
+            assert worst <= total / mesh_size * 1.5, (
+                f"{method}: max per-device bytes {worst} vs total {total} "
+                f"— state is (partially) replicated, expected ~1/{mesh_size}"
             )
 
     def test_tp_warns_when_nothing_shards(self, caplog):

@@ -72,6 +72,14 @@ CONFIGS = [
     # the fallback if window-1's full-taps compile failure repeats
     ("wgrad_taps_l1",
      {"BENCH_WGRAD_TAPS": "1", "DPT_WGRAD_TAPS_MIN_HW": "100000"}, 1500.0),
+    # compile-only probe for the Mosaic wgrad kernel (VERDICT r05
+    # next-8): 30 s to learn compiled-or-rejected BEFORE the full taps
+    # legs spend a window on a graph whose kernel may not even lower.
+    # A rejection lands as a config_error line (terminal); a wedge
+    # poisons only this 30 s probe, not a 2700 s measurement budget.
+    ("wgrad_pallas_probe",
+     {"BENCH_WGRAD_TAPS": "1", "DPT_WGRAD_BACKEND": "pallas",
+      "BENCH_COMPILE_ONLY": "1"}, 30.0),
     ("wgrad_taps", {"BENCH_WGRAD_TAPS": "1"}, 2700.0),
     # the taps path with the single-pass Pallas wgrad kernel
     # (ops/wgrad_pallas.py) on channels>=64 taps: Mosaic compile on top
@@ -86,6 +94,31 @@ _CONFIG_ENV_KEYS = sorted({k for _, env, _ in CONFIGS for k in env})
 
 _POISON_PREFIXES = ("watchdog", "wedged_previous_attempt")
 _INNOCENT_PREFIX = "runtime_error"
+
+# Liveness re-probe backoff after a retryable config failure: the relay
+# runtime is known to FLAP briefly (seconds to a couple of minutes) —
+# an immediate single re-probe reads a flap as a dead window and burns
+# it (both r05 windows ended this way). Probe, then back off 5/10/20 s
+# between further probes before declaring the runtime dead.
+REPROBE_ATTEMPTS = 4
+REPROBE_BASE_DELAY_S = 5.0
+
+# Error-message markers of a runtime-channel failure (grpc CHANNEL
+# status names + socket-ish strings): with a HEALTHY probe these mean
+# the in-process client blipped, not that the config is
+# deterministically broken — mark innocent (retryable), never
+# permanent. Deliberately NOT 'INTERNAL:' — Mosaic/XLA compile
+# rejections surface as INTERNAL and must stay terminal (the whole
+# point of the wgrad_pallas_probe is recording such a rejection once).
+_CHANNEL_MARKERS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+    "connection", "Connection", "socket", "stream terminated",
+)
+
+
+def _is_channel_error(exc) -> bool:
+    msg = str(exc)
+    return any(m in msg for m in _CHANNEL_MARKERS)
 
 
 def append_line(path: str, obj: dict) -> None:
@@ -138,6 +171,25 @@ def load_state(path: str) -> dict:
         })
         state[attempting] = "poison"
     return state
+
+
+def _reprobe_with_backoff(probe_once, timeout: float) -> dict:
+    """Re-probe a runtime that just answered dead, with exponential
+    backoff between attempts. Returns the first healthy probe (the
+    runtime was flapping, not dead) or the final dead one."""
+    delay = REPROBE_BASE_DELAY_S
+    probe = {"ok": False, "error": "no re-probe attempted"}
+    for attempt in range(REPROBE_ATTEMPTS):
+        if attempt:
+            print(f"bench_multi: runtime probe dead; backing off "
+                  f"{delay:.0f}s before re-probe "
+                  f"{attempt + 1}/{REPROBE_ATTEMPTS}")
+            time.sleep(delay)
+            delay *= 2
+        probe = probe_once(timeout)
+        if probe.get("ok"):
+            return probe
+    return probe
 
 
 def _arm_config_watchdog(path: str, name: str, secs: float):
@@ -251,29 +303,53 @@ def main(argv=None) -> int:
             # JAX surfaces deterministic config failures as
             # XlaRuntimeError (a RuntimeError subclass) too — only a
             # liveness probe can tell "the runtime died under this
-            # config" from "this config is just broken". A dead
-            # probe → innocent (a later window retries) and stop:
-            # nothing after it can init a backend in this process
-            # (jax caches the failed init). A healthy probe → the
-            # config itself failed deterministically → permanent,
-            # keep going with the rest.
-            if retryable and not _probe_once(
-                    args.probe_timeout).get("ok"):
+            # config" from "this config is just broken". A healthy
+            # probe → the config itself failed (channel-shaped errors
+            # excepted, below) → permanent, keep going with the rest.
+            # A dead probe no longer ends the window on the spot: the
+            # relay is known to FLAP for seconds-to-minutes, and both
+            # r05 windows were burned by reading a flap as a death —
+            # re-probe with exponential backoff first, and only a
+            # still-dead runtime returns the window (rc=4). Either way
+            # the config is marked innocent (it failed while the
+            # runtime was away; a later invocation retries it).
+            probe = (
+                _probe_once(args.probe_timeout) if retryable
+                else {"ok": True}
+            )
+            if probe.get("ok"):
+                if retryable and _is_channel_error(exc):
+                    # runtime alive but the in-process client's channel
+                    # blipped mid-config: the config is innocent (retry
+                    # later), not deterministically broken
+                    append_line(args.out, {
+                        "config": name,
+                        "error":
+                            f"runtime_error: {type(exc).__name__}: {exc}",
+                    })
+                    print(f"bench_multi: channel blip at config "
+                          f"{name!r} (runtime alive): {exc}")
+                    continue
                 append_line(args.out, {
                     "config": name,
-                    "error":
-                        f"runtime_error: {type(exc).__name__}: {exc}",
+                    "error": f"config_error: {type(exc).__name__}: {exc}",
                 })
-                print(f"bench_multi: runtime died at config {name!r}: "
+                print(f"bench_multi: deterministic failure in {name!r}: "
                       f"{exc}")
-                return 4
+                continue
             append_line(args.out, {
                 "config": name,
-                "error": f"config_error: {type(exc).__name__}: {exc}",
+                "error": f"runtime_error: {type(exc).__name__}: {exc}",
             })
-            print(f"bench_multi: deterministic failure in {name!r}: "
+            probe = _reprobe_with_backoff(_probe_once, args.probe_timeout)
+            if probe.get("ok"):
+                print(f"bench_multi: runtime flapped at config {name!r} "
+                      f"and recovered — continuing with remaining "
+                      f"configs: {exc}")
+                continue
+            print(f"bench_multi: runtime died at config {name!r}: "
                   f"{exc}")
-            continue
+            return 4
         dog.cancel()
         append_line(args.out, {"config": name, **result})
         print(json.dumps({"config": name, **result}))

@@ -38,13 +38,15 @@ RC=1
 for attempt in 1 2 3 4 5 6; do
     echo "== bench_multi invocation $attempt"
     # Belt-and-suspenders only: every config self-bounds via its own
-    # watchdog (sum of budgets = 13800s: 2x1200 + 4x1500 + 2x2700, plus
-    # per-config liveness probes at up to ~120s each), so this outer
-    # timeout must exceed that worst case — a SIGTERM here is
-    # indistinguishable from a wedge and would falsely poison-mark a
-    # healthy running config (the exact failure ADVICE r05 flagged when
-    # this was 11000s against the same 13800s sum).
-    timeout --signal=TERM 15000 \
+    # watchdog (sum of budgets = 13830s: 2x1200 + 4x1500 + 30 + 2x2700,
+    # plus per-config liveness probes at up to ~120s each, plus up to
+    # ~515s per retryable failure for the backed-off re-probes a
+    # flapping runtime now gets), so this outer timeout must exceed
+    # that worst case — a SIGTERM here is indistinguishable from a
+    # wedge and would falsely poison-mark a healthy running config
+    # (the exact failure ADVICE r05 flagged when this was 11000s
+    # against the same 13800s sum).
+    timeout --signal=TERM 16800 \
         python -u tools/bench_multi.py --out "$OUT/bench_multi.jsonl"
     RC=$?
     case $RC in
