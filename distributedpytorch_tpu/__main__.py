@@ -1,7 +1,24 @@
 """``python -m distributedpytorch_tpu`` → the training CLI (same surface
-as ``train.py`` / the ``dpt-train`` console script)."""
+as ``train.py`` / the ``dpt-train`` console script), plus the elastic
+supervisor subcommand:
 
-from distributedpytorch_tpu.cli import main
+    python -m distributedpytorch_tpu elastic -n 2 -- -t FSDP ...
+
+which spawns/supervises the worker ranks (dist/elastic.py) the way the
+reference's ``torchrun`` launcher does (README.md:37)."""
+
+import sys
+
+
+def main() -> None:
+    if len(sys.argv) > 1 and sys.argv[1] == "elastic":
+        from distributedpytorch_tpu.dist.elastic import main as elastic_main
+
+        sys.exit(elastic_main(sys.argv[2:]))
+    from distributedpytorch_tpu.cli import main as cli_main
+
+    cli_main()
+
 
 if __name__ == "__main__":
     main()

@@ -110,6 +110,20 @@ def _to_host(tree):
     return jax.tree.unflatten(treedef, out)
 
 
+def save_topology() -> dict:
+    """The mesh/sharding topology a checkpoint is being saved under —
+    recorded in the manifest so restore can SAY it is resharding
+    (N→M processes, different mesh shape) rather than silently assuming
+    an identical layout. Restore never *requires* a topology match:
+    `_to_host` gathers every leaf to a full host array at save time, so
+    the file is layout-free and re-places under any current mesh
+    (`Trainer._restore` logs the reshard when the topologies differ)."""
+    return {
+        "process_count": int(jax.process_count()),
+        "device_count": int(jax.device_count()),
+    }
+
+
 def _build_payload(
     params,
     opt_state=None,
@@ -119,12 +133,17 @@ def _build_payload(
     records_state: Optional[dict] = None,
     model_state=None,
     train_meta: Optional[dict] = None,
+    topology: Optional[dict] = None,
 ) -> dict:
     """Snapshot everything to HOST values. This is the only part of a save
     that must run on the trainer thread: device buffers are donated into
     the next dispatched step, so the device_get cannot be deferred."""
     return {
         "version": CKPT_VERSION,
+        # saving-time mesh topology (strategy name, mesh axis sizes,
+        # process/device counts) — informational manifest for the
+        # mesh-resharding restore path; absent in older checkpoints
+        "topology": {**save_topology(), **(topology or {})},
         # small scalar trainer state that must survive resume (best val
         # metrics for --save-best, early-stop patience counter) — plain
         # msgpack-able dict, absent in older checkpoints
@@ -150,6 +169,17 @@ def _build_payload(
 
 _TMP_COUNTER = itertools.count()
 
+# ONE lock around every rotate/rename/prune of a retention chain: the
+# chain is shared mutable state between the async writer thread, any
+# synchronous save (--sync-checkpoint, tests, tools), and external
+# pruning (a lowered --keep-checkpoints). Without it a prune can delete
+# the `path.1` slot an in-flight save just rotated its predecessor into
+# — exactly the file restore's fallback would need if that save's
+# rename then failed. Held only across cheap filesystem metadata ops
+# (the payload write itself happens to a unique tmp name outside any
+# contention), so serializing here costs nothing measurable.
+_RETENTION_LOCK = threading.Lock()
+
 
 def _rotate_retained(path: str, keep: int) -> None:
     """Shift the retained chain one slot: ``path`` → ``path.1`` → … up to
@@ -169,6 +199,16 @@ def _prune_retained(path: str, keep: int) -> None:
         stale = f"{path}.{i}"
         if os.path.exists(stale):
             os.remove(stale)
+
+
+def prune_retained(path: str, keep: int) -> None:
+    """Trim ``path``'s retention chain to the newest ``keep`` files —
+    the external entry point (tools, a lowered ``--keep-checkpoints``).
+    Takes the retention lock, so it can never race an in-flight
+    `save_checkpoint_async` write's rotate/rename out from under it
+    (tests/test_faults.py races exactly this)."""
+    with _RETENTION_LOCK:
+        _prune_retained(path, keep)
 
 
 def retained_checkpoints(path: str) -> List[str]:
@@ -195,9 +235,10 @@ def _write_payload(path: str, payload: dict, keep: int = 1) -> str:
         # half-way AND tore the destination (non-atomic filesystem, power
         # loss mid-rename). Rotate like a real save, leave torn bytes at
         # `path`, and raise — restore must fall back to `path.1`.
-        _rotate_retained(path, keep)
-        with open(path, "wb") as f:
-            f.write(blob[: max(1, len(blob) // 2)])
+        with _RETENTION_LOCK:
+            _rotate_retained(path, keep)
+            with open(path, "wb") as f:
+                f.write(blob[: max(1, len(blob) // 2)])
         raise faults.InjectedFault(
             f"injected ckpt_write fault: torn file left at {path}"
         )
@@ -206,9 +247,10 @@ def _write_payload(path: str, payload: dict, keep: int = 1) -> str:
         f.write(blob)
         f.write(_HASH_MAGIC)
         f.write(hashlib.sha256(blob).digest())
-    _rotate_retained(path, keep)
-    os.replace(tmp, path)
-    _prune_retained(path, keep)
+    with _RETENTION_LOCK:
+        _rotate_retained(path, keep)
+        os.replace(tmp, path)
+        _prune_retained(path, keep)
     return path
 
 
@@ -257,6 +299,7 @@ def save_checkpoint(
     train_meta: Optional[dict] = None,
     keep: int = 1,
     write: bool = True,
+    topology: Optional[dict] = None,
 ) -> None:
     """``write=False`` builds the payload WITHOUT touching disk — the
     multi-process contract: the host snapshot inside `_build_payload` is
@@ -271,6 +314,7 @@ def save_checkpoint(
         records_state,
         model_state,
         train_meta,
+        topology,
     )
     if write:
         _write_payload(path, payload, keep=keep)
@@ -311,6 +355,7 @@ def save_checkpoint_async(
     train_meta: Optional[dict] = None,
     keep: int = 1,
     write: bool = True,
+    topology: Optional[dict] = None,
 ) -> Optional[Future]:
     """`save_checkpoint` with the serialize+write half on the background
     writer: snapshots state to host NOW (cheap single device_get — also
@@ -333,6 +378,7 @@ def save_checkpoint_async(
         records_state,
         model_state,
         train_meta,
+        topology,
     )
     if not write:
         return None
@@ -443,6 +489,10 @@ def load_checkpoint(
         "records": payload.get("records"),
         "model_state": None,
         "train_meta": payload.get("train_meta"),
+        # saving-time mesh topology (None for pre-elastic checkpoints):
+        # the restore side compares it against the CURRENT topology and
+        # reports a resharding restore (train/loop.py `_restore`)
+        "topology": payload.get("topology"),
     }
     if payload.get("opt_state") is not None and opt_state_target is not None:
         out["opt_state"] = flax.serialization.from_state_dict(

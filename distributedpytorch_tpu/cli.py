@@ -154,11 +154,21 @@ def get_args():
     # itself, so a shared [] would leak armed faults across repeated
     # get_args() calls in one process
     parser.add_argument("--inject-fault", action="append", default=None,
-                        metavar="SITE:EPOCH:STEP[:COUNT]",
+                        metavar="SITE[@RANK]:EPOCH:STEP[:COUNT]",
                         help="Arm a deterministic fault (repeatable; "
                              "sites: decode, placement, nan_loss, "
-                             "ckpt_write, sigterm; '*' wildcards) — for "
-                             "recovery drills and tests")
+                             "ckpt_write, sigterm, rank_kill, rank_hang; "
+                             "'*' wildcards, '@RANK' pins one process) — "
+                             "for recovery drills and tests")
+    # elastic runtime (dist/elastic.py appends these to every worker)
+    parser.add_argument("--checkpoint-dir", type=str, default="./checkpoints",
+                        help="Where epoch checkpoints live (the elastic "
+                             "supervisor resumes from here)")
+    parser.add_argument("--heartbeat-dir", type=str, default=None,
+                        help="Write a per-rank heartbeat file here (armed "
+                             "by the elastic supervisor; off when unset)")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.5,
+                        help="Heartbeat write cadence in seconds")
     return parser.parse_args()
 
 
@@ -166,6 +176,17 @@ def resolve_checkpoint_arg(args):
     """The -c/-l aliasing: -c wins, then -l (which the reference parses but
     ignores — here it actually loads, reference train.py:19 vs :23)."""
     return args.checkpoint or args.load or None
+
+
+def _channel_shaped(exc: BaseException) -> bool:
+    """Does this exception look like a dead/flapping runtime channel —
+    i.e. a PEER failure, not this rank's own bug? One definition with
+    the retry taxonomy (utils/faults.is_transient): the OSError family
+    plus grpc/socket-marked RuntimeErrors, which is exactly how a gloo
+    peer's death presents on every survivor."""
+    from distributedpytorch_tpu.utils.faults import is_transient
+
+    return is_transient(exc)
 
 
 def _enable_compilation_cache():
@@ -237,6 +258,9 @@ def main():
         step_timeout_s=args.step_timeout,
         keep_checkpoints=args.keep_checkpoints,
         inject_faults=tuple(args.inject_fault or ()),
+        checkpoint_dir=args.checkpoint_dir,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_interval_s=args.heartbeat_interval,
     )
 
     # logfile parity: ./logs/{method}.log, append, message-only (reference
@@ -253,15 +277,42 @@ def main():
     logging.info("UNet for Carvana Image Masking (Segmentation)")
 
     try:
-        if args.max_restarts > 0:
-            from distributedpytorch_tpu.train import fit_with_restarts
+        try:
+            if args.max_restarts > 0:
+                from distributedpytorch_tpu.train import fit_with_restarts
 
-            result, trainer = fit_with_restarts(
-                config, max_restarts=args.max_restarts, return_trainer=True
-            )
-        else:
-            trainer = Trainer(config)
-            result = trainer.train()
+                result, trainer = fit_with_restarts(
+                    config, max_restarts=args.max_restarts, return_trainer=True
+                )
+            else:
+                trainer = Trainer(config)
+                result = trainer.train()
+        except Exception as exc:  # noqa: BLE001 — classified, then re-raised
+            if runtime.num_processes > 1 and _channel_shaped(exc):
+                # A dead/hung gloo peer surfaces on EVERY survivor as a
+                # wall of channel-shaped tracebacks that say nothing
+                # about which rank actually failed. Print ONE line and
+                # exit with the peer-failure code; the elastic
+                # supervisor's health classifier owns the real
+                # attribution (`rank R: <dead|hung|desynced> at
+                # epoch:step`, dist/health.py) and treats this exit as
+                # a casualty, not a cause.
+                logging.error(
+                    "rank %d: aborting on distributed peer failure "
+                    "(%s: %.200s) — see the supervisor's per-rank summary",
+                    runtime.process_id, type(exc).__name__, exc,
+                )
+                # os._exit, NOT sys.exit: SystemExit would unwind into
+                # the finally's shutdown(), whose coordination barrier
+                # blocks on the very peer that just died (the hazard
+                # tests/ddp_worker.py documents) — the survivor would
+                # hang until the supervisor SIGKILLs it and the
+                # PEER_FAILURE_EXIT attribution would be lost.
+                logging.shutdown()
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(13)  # dist/elastic.PEER_FAILURE_EXIT
+            raise
         if args.export_pth and runtime.is_main:
             pth = os.path.join(config.checkpoint_dir, f"{config.method_tag}.pth")
             if config.model_arch == "milesial":

@@ -163,9 +163,20 @@ class TrainConfig:
     # content hash and falls back to the newest intact one, so N >= 2
     # makes a torn newest file recoverable. 1 = overwrite in place.
     keep_checkpoints: int = 2
-    # Deterministic fault injection (tests / drills): "site:epoch:step
-    # [:count]" specs, sites in utils/faults.SITES. Empty = inert.
+    # Deterministic fault injection (tests / drills): "site[@rank]:
+    # epoch:step[:count]" specs, sites in utils/faults.SITES ("@rank"
+    # pins a fault to one process of a multi-process job). Empty = inert.
     inject_faults: Tuple[str, ...] = ()
+    # Elastic runtime (dist/health.py, dist/elastic.py): when set, the
+    # trainer writes a per-rank beat file (rank_R.beat) into this
+    # directory from a daemon thread — the supervisor's failure
+    # detector. The step loop only assigns attributes per iteration
+    # (no host sync, no collective); the thread writes at
+    # heartbeat_interval_s cadence. None = no heartbeat (non-elastic
+    # runs are untouched). Normally armed by the supervisor, which
+    # appends --heartbeat-dir to every worker it launches.
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = 0.5
 
     # -- synthetic data (tests / benches without the Carvana download) ------
     synthetic_samples: int = 0  # >0: use an in-memory procedural dataset

@@ -1,4 +1,6 @@
-"""Multi-process runtime glue (the reference's torch.distributed layer)."""
+"""Multi-process runtime glue (the reference's torch.distributed layer):
+process-group init (runtime.py), rank health/heartbeats (health.py), and
+the elastic supervisor (elastic.py — the torchrun/TorchElastic role)."""
 
 from distributedpytorch_tpu.dist.runtime import (  # noqa: F401
     RuntimeInfo,

@@ -1,0 +1,542 @@
+"""Elastic multi-process supervisor: spawn, watch, relaunch, reshard.
+
+The reference's launcher is `torchrun` (README.md:37) — i.e. TorchElastic:
+an agent that supervises worker ranks, detects failures, and restarts
+the job, possibly at a different world size. `jax.distributed` has no
+such layer; this module provides it, provable end-to-end on the 2–4
+process CPU/gloo mesh (tests/test_elastic.py):
+
+  * **spawn** — launch N worker ranks of ``python -m
+    distributedpytorch_tpu`` (or any command) with the torchrun-style
+    env contract `dist/runtime.py` already maps onto
+    `jax.distributed.initialize`, a fresh rendezvous port per attempt,
+    per-rank log files, and a per-attempt heartbeat directory
+    (``--heartbeat-dir`` is appended to the worker argv);
+  * **watch** — poll exit codes + the beat files; `dist/health.classify`
+    turns them into per-rank verdicts (dead / hung / desynced) within a
+    bounded window (``--heartbeat-timeout`` beat age, opt-in
+    ``--progress-timeout`` step-progress age, spawn grace for workers
+    that die before their first beat);
+  * **teardown** — on any failed rank, SIGTERM the survivors (they are
+    blocked inside collectives their dead peer abandoned), wait
+    ``--teardown-grace``, SIGKILL stragglers — and print ONE line per
+    failed rank (``rank R: dead at epoch:step``) instead of every
+    survivor's wall of channel tracebacks;
+  * **relaunch** — up to ``--max-restarts`` times with exponential
+    backoff, resuming from the newest intact retained checkpoint
+    (``-c <method>`` appended to the worker argv once one exists — the
+    mesh-resharding restore in checkpoint.py makes that work even when
+    the world size changed);
+  * **elastic world size** — a rank index that fails
+    ``--rank-fail-limit`` consecutive attempts is treated as a lost
+    slot: the job relaunches on the remaining M ranks (never below
+    ``--min-ranks``), and the checkpoint saved on N processes reshards
+    onto the M-process mesh.
+
+Chaos drills: ``--chaos SITE[@RANK]:EPOCH:STEP[:COUNT]`` arms a fault
+(utils/faults.py — ``rank_kill`` / ``rank_hang`` live in the step loop)
+via ``--inject-fault`` on the FIRST attempt only, so the relaunched
+attempt does not immediately re-kill itself at the same coordinates.
+
+Deliberately jax-free: the supervisor process never initializes a
+backend (and never dials a tunneled TPU runtime) — all its knowledge of
+the job comes from exit codes, beat files, and the checkpoint chain on
+disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from distributedpytorch_tpu.dist import health
+
+logger = logging.getLogger(__name__)
+
+#: rc a worker may use for "I am aborting because a PEER failed" (see
+#: cli.py's per-rank error summary): the supervisor attributes the
+#: failure to the primary rank, not to survivors that died of it.
+PEER_FAILURE_EXIT = 13
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_arg(args: Sequence[str], names: Sequence[str], default: str) -> str:
+    """Pull a flag value out of the worker argv (last occurrence wins,
+    like argparse). Supports ``--flag value`` and ``--flag=value``."""
+    value = default
+    args = list(args)
+    for i, a in enumerate(args):
+        for n in names:
+            if a == n and i + 1 < len(args):
+                value = args[i + 1]
+            elif a.startswith(n + "="):
+                value = a.split("=", 1)[1]
+    return value
+
+
+def _checkpoint_exists(checkpoint_dir: str, tag: str) -> bool:
+    """Is there anything resumable on disk? Mirrors
+    `checkpoint.retained_checkpoints` without importing the jax/flax
+    stack into the supervisor process."""
+    base = os.path.join(checkpoint_dir, f"{tag}.ckpt")
+    if os.path.exists(base):
+        return True
+    return any(os.path.exists(f"{base}.{i}") for i in range(1, 64))
+
+
+@dataclasses.dataclass
+class AttemptResult:
+    """What one launch attempt came to (recorded in the report JSON)."""
+
+    attempt: int
+    world: int
+    ok: bool
+    failures: List[str]  # the one-line per-rank summaries
+    exit_codes: Dict[int, Optional[int]]
+    duration_s: float
+
+
+class ElasticSupervisor:
+    """Supervise one elastic job (see module docstring).
+
+    ``worker_cmd`` is the base command (default: this package's CLI);
+    ``worker_args`` is appended to it. The supervisor appends per-rank
+    env (RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT), the heartbeat
+    flags, ``--chaos`` specs (attempt 0 only), and ``-c <tag>`` once a
+    checkpoint exists."""
+
+    def __init__(
+        self,
+        worker_args: Sequence[str],
+        nprocs: int,
+        worker_cmd: Optional[Sequence[str]] = None,
+        min_ranks: int = 1,
+        max_restarts: int = 3,
+        heartbeat_timeout_s: float = 10.0,
+        heartbeat_interval_s: float = 0.5,
+        progress_timeout_s: float = 0.0,
+        spawn_timeout_s: float = 300.0,
+        poll_interval_s: float = 0.25,
+        restart_backoff_s: float = 1.0,
+        teardown_grace_s: float = 10.0,
+        rank_fail_limit: int = 2,
+        run_dir: str = "./elastic_run",
+        report_path: Optional[str] = None,
+        cpu_devices: int = 0,
+        chaos: Sequence[str] = (),
+        env: Optional[Dict[str, str]] = None,
+        cwd: Optional[str] = None,
+    ):
+        if nprocs < 1:
+            raise ValueError(f"nprocs must be >= 1, got {nprocs}")
+        if not 1 <= min_ranks <= nprocs:
+            raise ValueError(
+                f"min_ranks must be in [1, {nprocs}], got {min_ranks}"
+            )
+        self.worker_args = list(worker_args)
+        self.worker_cmd = list(
+            worker_cmd
+            if worker_cmd is not None
+            else [sys.executable, "-u", "-m", "distributedpytorch_tpu"]
+        )
+        self.nprocs = int(nprocs)
+        self.min_ranks = int(min_ranks)
+        self.max_restarts = int(max_restarts)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.heartbeat_interval_s = float(heartbeat_interval_s)
+        self.progress_timeout_s = float(progress_timeout_s)
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self.poll_interval_s = float(poll_interval_s)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.teardown_grace_s = float(teardown_grace_s)
+        self.rank_fail_limit = max(1, int(rank_fail_limit))
+        # absolute: the workers receive this path in their argv and may
+        # run under a different cwd than the supervisor
+        self.run_dir = os.path.abspath(str(run_dir))
+        self.report_path = report_path or os.path.join(
+            self.run_dir, "report.json"
+        )
+        self.cpu_devices = int(cpu_devices)
+        self.chaos = tuple(chaos)
+        self.base_env = dict(env) if env is not None else None
+        self.cwd = cwd  # workers' cwd (their relative artifact dirs)
+
+        # resume coordinates, parsed from the worker argv (the trainer's
+        # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt)
+        self.method_tag = _worker_arg(
+            self.worker_args, ("-t", "--train-method"), "singleGPU"
+        )
+        ckpt_dir = _worker_arg(
+            self.worker_args, ("--checkpoint-dir",), "./checkpoints"
+        )
+        if not os.path.isabs(ckpt_dir):
+            # a relative checkpoint dir is resolved by the WORKERS
+            # against their cwd; the resume check here must look in the
+            # same place or every relaunch silently restarts from
+            # scratch (the supervisor's own cwd may differ)
+            ckpt_dir = os.path.join(self.cwd or os.getcwd(), ckpt_dir)
+        self.checkpoint_dir = ckpt_dir
+
+        self.restarts = 0
+        self.world_history: List[int] = []
+        self.attempts: List[AttemptResult] = []
+        self._procs: List[subprocess.Popen] = []
+
+    # ------------------------------------------------------------------
+    def _worker_env(self, rank: int, world: int, port: int) -> Dict[str, str]:
+        if self.cpu_devices > 0:
+            # CPU-mesh drills/tests: ONE definition of the virtual-device
+            # provisioning moves (utils/provision.py — jax-free module)
+            from distributedpytorch_tpu.utils.provision import provisioned_env
+
+            env = provisioned_env(self.cpu_devices, base=self.base_env)
+        else:
+            env = dict(os.environ if self.base_env is None else self.base_env)
+        env.update(
+            {
+                "RANK": str(rank),
+                "LOCAL_RANK": str(rank),
+                "WORLD_SIZE": str(world),
+                "MASTER_ADDR": "127.0.0.1",
+                "MASTER_PORT": str(port),
+            }
+        )
+        # a worker stuck joining a rendezvous whose peers died must fail
+        # fast (dist/runtime._init_timeout_kwargs) — the supervisor, not
+        # jax's 300 s default, owns the retry loop
+        env.setdefault(
+            "DPT_DIST_INIT_TIMEOUT_S",
+            str(int(max(30.0, self.spawn_timeout_s))),
+        )
+        # per-rank persistent XLA compilation caches: co-launched ranks
+        # compiling identical tiny-model entries race a shared cache dir
+        # (same reason tests/test_multiprocess.py splits per rank)
+        prefix = env.pop("DPT_XLA_CACHE_PREFIX", None)
+        if prefix:
+            env["JAX_COMPILATION_CACHE_DIR"] = f"{prefix}_rank{rank}"
+        return env
+
+    def _worker_argv(self, attempt: int) -> List[str]:
+        argv = self.worker_cmd + self.worker_args
+        argv += [
+            "--heartbeat-dir", self._hb_dir(attempt),
+            "--heartbeat-interval", str(self.heartbeat_interval_s),
+        ]
+        if attempt == 0:
+            for spec in self.chaos:
+                argv += ["--inject-fault", spec]
+        # resume from the newest intact retained checkpoint once one
+        # exists. Appended LAST so it wins over any user-passed -c
+        # (argparse last-occurrence semantics) — a restart must resume
+        # THIS job, not reload the user's warm-start weights again.
+        if attempt > 0 and _checkpoint_exists(self.checkpoint_dir, self.method_tag):
+            argv += ["-c", self.method_tag]
+        return argv
+
+    def _hb_dir(self, attempt: int) -> str:
+        # fresh beat dir per attempt: stale beats from a torn-down world
+        # must never be classified against the relaunched one
+        return os.path.join(self.run_dir, f"attempt{attempt}", "heartbeat")
+
+    def _log_path(self, attempt: int, rank: int) -> str:
+        return os.path.join(
+            self.run_dir, f"attempt{attempt}", f"rank{rank}.log"
+        )
+
+    # ------------------------------------------------------------------
+    def _spawn(self, attempt: int, world: int) -> None:
+        port = _free_port()
+        argv = self._worker_argv(attempt)
+        os.makedirs(self._hb_dir(attempt), exist_ok=True)
+        logger.info(
+            "elastic attempt %d: launching %d rank(s): %s",
+            attempt, world, shlex.join(argv),
+        )
+        self._procs = []
+        self._log_files = []
+        try:
+            for rank in range(world):
+                log_f = open(self._log_path(attempt, rank), "ab")
+                self._log_files.append(log_f)
+                self._procs.append(
+                    subprocess.Popen(
+                        argv,
+                        env=self._worker_env(rank, world, port),
+                        cwd=self.cwd,
+                        stdout=log_f,
+                        stderr=subprocess.STDOUT,
+                    )
+                )
+        except Exception:
+            # a spawn failure on rank k (fd exhaustion, ENOMEM) must not
+            # orphan ranks 0..k-1: they hold the rendezvous port and
+            # would keep mutating checkpoints with no supervisor
+            self._teardown()
+            raise
+
+    def _exit_codes(self) -> Dict[int, Optional[int]]:
+        return {r: p.poll() for r, p in enumerate(self._procs)}
+
+    def _classify(self, attempt: int, world: int, started_at: float):
+        return health.classify(
+            world,
+            health.read_beats(self._hb_dir(attempt)),
+            self._exit_codes(),
+            timeout_s=self.heartbeat_timeout_s,
+            started_at=started_at,
+            spawn_timeout_s=self.spawn_timeout_s,
+            progress_timeout_s=self.progress_timeout_s,
+        )
+
+    def _teardown(self) -> None:
+        """Stop every surviving rank: SIGTERM (the trainer checkpoints
+        and exits at the next agreed boundary when it can), grace,
+        SIGKILL stragglers (a survivor blocked inside a collective its
+        dead peer abandoned cannot run its handler)."""
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.teardown_grace_s
+        while time.monotonic() < deadline and any(
+            p.poll() is None for p in self._procs
+        ):
+            time.sleep(0.1)
+        for p in self._procs:
+            if p.poll() is None:
+                p.kill()
+        for p in self._procs:
+            p.wait()
+        for f in getattr(self, "_log_files", []):
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def _watch(self, attempt: int, world: int) -> Dict[int, health.RankHealth]:
+        """Block until the attempt resolves: every rank exits 0 (all-ok
+        map) or some rank fails (classified map). Never raises on worker
+        behavior — classification is the contract."""
+        started_at = time.time()
+        while True:
+            codes = self._exit_codes()
+            if all(rc == 0 for rc in codes.values()):
+                # still consult the beats: a desynced world tears itself
+                # down CLEANLY (every rank marks its beat, snapshots,
+                # and exits 0 via the agreed stop) — all-zero exit codes
+                # alone would report that truncated job as success
+                verdicts = self._classify(attempt, world, started_at)
+                if any(h.failed for h in verdicts.values()):
+                    return verdicts
+                return {
+                    r: health.RankHealth(r, "ok") for r in range(world)
+                }
+            verdicts = self._classify(attempt, world, started_at)
+            # a PEER_FAILURE_EXIT rank is a casualty, not a cause; only
+            # treat it as the failure if NO primary failure exists
+            primary = {
+                r: h for r, h in verdicts.items()
+                if h.failed and codes.get(r) != PEER_FAILURE_EXIT
+            }
+            if primary or any(h.failed for h in verdicts.values()):
+                # give one extra beat-interval for a primary failure to
+                # surface before blaming a secondary exit
+                if not primary:
+                    time.sleep(self.heartbeat_interval_s)
+                    verdicts = self._classify(attempt, world, started_at)
+                return verdicts
+            time.sleep(self.poll_interval_s)
+
+    # ------------------------------------------------------------------
+    def _write_report(self, final: Optional[str] = None) -> None:
+        os.makedirs(
+            os.path.dirname(os.path.abspath(self.report_path)), exist_ok=True
+        )
+        payload = {
+            "restarts": self.restarts,
+            "world_history": self.world_history,
+            "final": final,
+            "attempts": [dataclasses.asdict(a) for a in self.attempts],
+        }
+        tmp = f"{self.report_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=2)
+        os.replace(tmp, self.report_path)
+
+    def run(self) -> int:
+        """Supervise to completion. Returns 0 when an attempt finishes
+        with every rank at exit 0; 1 when the restart budget is
+        exhausted (the report JSON holds the full per-attempt record
+        either way)."""
+        world = self.nprocs
+        attempt = 0
+        consecutive_fails = {r: 0 for r in range(world)}
+        while True:
+            self.world_history.append(world)
+            t0 = time.monotonic()
+            self._spawn(attempt, world)
+            verdicts = self._watch(attempt, world)
+            failed = {r: h for r, h in verdicts.items() if h.failed}
+            # snapshot exit codes BEFORE teardown: a healthy survivor the
+            # supervisor is about to SIGTERM must not be recorded as if
+            # it died on its own (the report would contradict its own
+            # failure lines)
+            codes = self._exit_codes()
+            self._teardown()
+            lines = health.format_failures(verdicts)
+            self.attempts.append(
+                AttemptResult(
+                    attempt=attempt,
+                    world=world,
+                    ok=not failed,
+                    failures=lines,
+                    exit_codes=codes,
+                    duration_s=time.monotonic() - t0,
+                )
+            )
+            if not failed:
+                self._write_report(final="ok")
+                logger.info(
+                    "elastic job complete: %d restart(s), world history %s",
+                    self.restarts, self.world_history,
+                )
+                return 0
+            # the per-rank error summary (docs/RELIABILITY.md): one line
+            # per failed rank, not a wall of survivor tracebacks
+            for line in lines:
+                logger.error("%s", line)
+            if self.restarts >= self.max_restarts:
+                self._write_report(final="failed")
+                logger.error(
+                    "elastic job failed: restart budget (%d) exhausted; "
+                    "per-rank logs under %s",
+                    self.max_restarts, self.run_dir,
+                )
+                return 1
+            # elastic world size: a rank index that failed
+            # rank_fail_limit consecutive attempts is a lost slot.
+            # PEER_FAILURE_EXIT ranks are casualties of someone else's
+            # failure, not failing slots — counting them would shrink
+            # the world by every healthy rank that died OF the one bad
+            # slot.
+            for r in range(world):
+                slot_failed = r in failed and codes.get(r) != PEER_FAILURE_EXIT
+                consecutive_fails[r] = (
+                    consecutive_fails.get(r, 0) + 1 if slot_failed else 0
+                )
+            lost = sum(
+                1 for r in range(world)
+                if consecutive_fails.get(r, 0) >= self.rank_fail_limit
+            )
+            new_world = max(self.min_ranks, world - lost)
+            if new_world != world:
+                logger.warning(
+                    "elastic: %d slot(s) failed %d consecutive attempt(s) — "
+                    "relaunching on %d rank(s) (was %d); the checkpoint "
+                    "reshards onto the smaller mesh",
+                    lost, self.rank_fail_limit, new_world, world,
+                )
+                world = new_world
+                consecutive_fails = {r: 0 for r in range(world)}
+            self.restarts += 1
+            self._write_report(final=None)
+            backoff = self.restart_backoff_s * (2.0 ** (self.restarts - 1))
+            logger.warning(
+                "elastic: relaunching (restart %d/%d, world %d) in %.1fs",
+                self.restarts, self.max_restarts, world, backoff,
+            )
+            time.sleep(backoff)
+            attempt += 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m distributedpytorch_tpu elastic -n N [opts] -- <train
+    args...>`` — the torchrun-shaped launch surface (reference
+    README.md:37), with supervision."""
+    ap = argparse.ArgumentParser(
+        prog="python -m distributedpytorch_tpu elastic",
+        description="Elastic supervisor: spawn N ranks, detect failures "
+        "via heartbeats, relaunch from the newest intact checkpoint "
+        "(possibly at a smaller world size).",
+    )
+    ap.add_argument("-n", "--nprocs", type=int, required=True,
+                    help="Worker ranks to launch")
+    ap.add_argument("--min-ranks", type=int, default=1,
+                    help="Never relaunch below this world size")
+    ap.add_argument("--max-restarts", type=int, default=3,
+                    help="Relaunch budget (exponential backoff between)")
+    ap.add_argument("--heartbeat-timeout", type=float, default=10.0,
+                    help="Beat-file age (s) beyond which a live rank is hung")
+    ap.add_argument("--heartbeat-interval", type=float, default=0.5,
+                    help="Worker beat cadence (s); passed to workers")
+    ap.add_argument("--progress-timeout", type=float, default=0.0,
+                    help="Step-progress age (s) beyond which a rank is hung "
+                         "(0 = off; set above compile/eval duration)")
+    ap.add_argument("--spawn-timeout", type=float, default=300.0,
+                    help="Grace (s) for a worker to write its first beat")
+    ap.add_argument("--restart-backoff", type=float, default=1.0,
+                    help="Base relaunch backoff (doubles per restart)")
+    ap.add_argument("--teardown-grace", type=float, default=10.0,
+                    help="SIGTERM→SIGKILL grace for survivors")
+    ap.add_argument("--rank-fail-limit", type=int, default=2,
+                    help="Consecutive failures before a slot is dropped")
+    ap.add_argument("--run-dir", type=str, default="./elastic_run",
+                    help="Heartbeats, per-rank logs, report.json")
+    ap.add_argument("--report", type=str, default=None,
+                    help="Report JSON path (default <run-dir>/report.json)")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="Give each rank an N-device virtual CPU mesh "
+                         "(drills/tests; 0 = inherit the real backend)")
+    ap.add_argument("--chaos", action="append", default=[],
+                    metavar="SITE[@RANK]:EPOCH:STEP[:COUNT]",
+                    help="Arm a fault (--inject-fault) on the FIRST "
+                         "attempt only — drills the detect/relaunch path "
+                         "without re-killing the relaunched job")
+    ap.add_argument("worker_args", nargs=argparse.REMAINDER,
+                    help="Training CLI args (prefix with --)")
+    args = ap.parse_args(argv)
+
+    worker_args = list(args.worker_args)
+    if worker_args and worker_args[0] == "--":
+        worker_args = worker_args[1:]
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    sup = ElasticSupervisor(
+        worker_args,
+        nprocs=args.nprocs,
+        min_ranks=args.min_ranks,
+        max_restarts=args.max_restarts,
+        heartbeat_timeout_s=args.heartbeat_timeout,
+        heartbeat_interval_s=args.heartbeat_interval,
+        progress_timeout_s=args.progress_timeout,
+        spawn_timeout_s=args.spawn_timeout,
+        restart_backoff_s=args.restart_backoff,
+        teardown_grace_s=args.teardown_grace,
+        rank_fail_limit=args.rank_fail_limit,
+        run_dir=args.run_dir,
+        report_path=args.report,
+        cpu_devices=args.cpu_devices,
+        chaos=args.chaos,
+    )
+    return sup.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,310 @@
+"""Rank health: heartbeats, failure classification, step agreement.
+
+TorchElastic's agent watches its workers (the reference launches via
+`torchrun`, README.md:37, whose modern form IS TorchElastic); the JAX
+runtime has no such layer — a SIGKILLed rank leaves its peers blocked
+inside a gloo/XLA collective until some distant channel deadline, with
+no record of WHO died or WHERE. This module is the detection half of
+the elastic runtime (`dist/elastic.py` is the supervision half):
+
+  * **Heartbeat** (worker side): a daemon thread that writes a per-rank
+    beat file (JSON: pid, epoch, step, wall time, status) every
+    ``interval_s`` seconds. The trainer's step loop only assigns two
+    integer attributes per iteration (`update`) — no host sync, no
+    collective, nothing on the step critical path. Files are written
+    atomically (tmp + rename) so a reader never sees a torn beat.
+  * **read_beats / classify** (supervisor side): parse the beat
+    directory and classify every expected rank as ``ok`` / ``dead``
+    (its process exited) / ``hung`` (process alive but no beat within
+    the timeout) / ``desynced`` (beat-marked by the trainer's step
+    agreement, or epoch counters more than one epoch apart — legal skew
+    is bounded by the per-epoch collectives, so a larger gap means a
+    rank is no longer executing the same program).
+  * **format_failures**: the one-line-per-rank summary
+    (``rank R: <dead|hung|desynced> at epoch:step``) that replaces the
+    wall of channel-shaped tracebacks every survivor used to print.
+
+Deliberately jax-free: the supervisor imports this before any backend
+initializes, and the classifier must be unit-testable with fabricated
+beats (tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+#: Classifier states, in display-priority order.
+STATES = ("ok", "dead", "hung", "desynced")
+
+#: Legal epoch skew between live ranks: the per-epoch collectives (stop
+#: agreement, eval, checkpoint gather) bound how far ahead a healthy
+#: rank can run — more than one epoch apart means divergent programs.
+MAX_EPOCH_SKEW = 1
+
+
+def beat_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, f"rank_{int(rank)}.beat")
+
+
+@dataclasses.dataclass
+class Beat:
+    """One parsed beat file (the worker's last self-report).
+
+    ``time`` is when the beat THREAD last wrote (stops only if the whole
+    process is frozen — the thread survives a step loop wedged inside a
+    native call); ``progress_time`` is when the STEP LOOP last called
+    ``update`` (stops the moment the loop stops making progress, which
+    is how a hang inside a collective actually presents)."""
+
+    rank: int
+    pid: int
+    epoch: int = 0
+    step: int = 0
+    time: float = 0.0
+    progress_time: float = 0.0
+    # does the progress timeout apply? The trainer mirrors the step
+    # watchdog's exemption: the FIRST executed epoch compiles every
+    # executable shape (minutes on a cold cache) with zero step
+    # progress, so a hang verdict there would kill healthy jobs —
+    # progress is judged only once the worker says it is in steady
+    # state. False for beats that never say (stubs, old formats).
+    timed: bool = False
+    status: str = "ok"  # "ok" | "desynced" (set by the step agreement)
+
+    @property
+    def coords(self) -> str:
+        return f"{self.epoch}:{self.step}"
+
+
+@dataclasses.dataclass
+class RankHealth:
+    """Classifier verdict for one rank."""
+
+    rank: int
+    state: str  # one of STATES
+    epoch: int = 0
+    step: int = 0
+    detail: str = ""
+
+    @property
+    def failed(self) -> bool:
+        return self.state != "ok"
+
+
+class Heartbeat:
+    """Worker-side beat writer: one daemon thread, one file per rank.
+
+    ``update(epoch, step)`` is the ONLY per-step call and does two
+    attribute assignments — the file write happens on the thread at
+    ``interval_s`` cadence (plus once immediately at start, so a rank
+    that wedges during its very first compile still registers as alive-
+    then-hung rather than never-launched). ``mark(status)`` lets the
+    trainer flag a classified condition (desync) for the supervisor."""
+
+    def __init__(self, directory: str, rank: int, interval_s: float = 1.0):
+        self.directory = str(directory)
+        self.rank = int(rank)
+        self.interval_s = max(0.05, float(interval_s))
+        self.epoch = 0
+        self.step = 0
+        self.progress_time = time.time()
+        self.timed = False
+        self.status = "ok"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # mark() writes from the trainer thread while the beat thread
+        # writes on its interval; both share one tmp name (keyed by
+        # pid), so unserialized writes could rename a torn beat into
+        # place
+        self._write_lock = threading.Lock()
+
+    # -- trainer-facing (hot path: attribute assignments only) --------------
+    def update(self, epoch: int, step: int) -> None:
+        self.epoch = epoch
+        self.step = step
+        self.progress_time = time.time()
+
+    def mark(self, status: str) -> None:
+        self.status = status
+        self._write()  # a classified failure must not wait out the interval
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Heartbeat":
+        os.makedirs(self.directory, exist_ok=True)
+        self._write()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name=f"dpt-heartbeat-r{self.rank}"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self._write()  # final beat: the exit coordinates
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self._write()
+
+    def _write(self) -> None:
+        payload = {
+            "rank": self.rank,
+            "pid": os.getpid(),
+            "epoch": int(self.epoch),
+            "step": int(self.step),
+            "time": time.time(),
+            "progress_time": self.progress_time,
+            "timed": bool(self.timed),
+            "status": self.status,
+        }
+        path = beat_path(self.directory, self.rank)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with self._write_lock:
+                with open(tmp, "w") as f:
+                    json.dump(payload, f)
+                os.replace(tmp, path)
+        except OSError:  # beat loss is tolerable; crashing the rank is not
+            logger.debug("heartbeat write failed", exc_info=True)
+
+
+def read_beats(directory: str) -> Dict[int, Beat]:
+    """Parse every rank's beat file; unreadable/torn files are skipped
+    (the atomic write makes that a transient, not a corruption)."""
+    beats: Dict[int, Beat] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return beats
+    for name in names:
+        if not (name.startswith("rank_") and name.endswith(".beat")):
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                d = json.load(f)
+            beat = Beat(
+                rank=int(d["rank"]),
+                pid=int(d.get("pid", 0)),
+                epoch=int(d.get("epoch", 0)),
+                step=int(d.get("step", 0)),
+                time=float(d.get("time", 0.0)),
+                progress_time=float(d.get("progress_time", d.get("time", 0.0))),
+                timed=bool(d.get("timed", False)),
+                status=str(d.get("status", "ok")),
+            )
+        except (OSError, ValueError, KeyError):
+            continue
+        beats[beat.rank] = beat
+    return beats
+
+
+def classify(
+    world: int,
+    beats: Dict[int, Beat],
+    exited: Dict[int, Optional[int]],
+    timeout_s: float,
+    now: Optional[float] = None,
+    started_at: Optional[float] = None,
+    spawn_timeout_s: Optional[float] = None,
+    progress_timeout_s: float = 0.0,
+) -> Dict[int, RankHealth]:
+    """Classify every rank of an N-rank job.
+
+    ``exited`` maps rank → exit code (None while the process is still
+    running) — the supervisor knows this from ``Popen.poll()``, which is
+    both faster and more certain than any beat-derived inference, so a
+    dead process wins over everything. Precedence per rank:
+
+      1. **dead** — process exited nonzero (or by signal: negative rc);
+         a clean 0 exit is ``ok`` (the job may legitimately finish).
+      2. **desynced** — the rank's own step agreement marked it, or its
+         epoch counter is > :data:`MAX_EPOCH_SKEW` behind the most
+         advanced LIVE rank (collectives bound legal skew).
+      3. **hung** — live process, but (a) the newest beat is older than
+         ``timeout_s`` (whole process frozen: SIGSTOP, GIL-held wedge —
+         the beat thread itself survives a step loop stuck inside a
+         native call), or (b) ``progress_timeout_s`` > 0 and the step
+         loop has not advanced within it (a hang inside a collective
+         presents exactly this way: the beat stays fresh, progress
+         stops), or (c) no beat was EVER written within
+         ``spawn_timeout_s`` of ``started_at`` (worker died before
+         reaching the trainer; only judged when ``started_at`` given).
+      4. **ok** otherwise.
+
+    Detection latency is bounded: a dead rank is seen at the next
+    supervisor poll; a hung rank within its timeout + one poll; a
+    desynced rank at its next per-epoch agreement (which `mark`\\ s the
+    beat immediately).
+    """
+    now = time.time() if now is None else now
+    spawn_timeout_s = timeout_s if spawn_timeout_s is None else spawn_timeout_s
+    live_epochs = [
+        b.epoch for r, b in beats.items()
+        if r < world and exited.get(r) is None
+    ]
+    frontier = max(live_epochs) if live_epochs else 0
+    out: Dict[int, RankHealth] = {}
+    for rank in range(world):
+        beat = beats.get(rank)
+        epoch = beat.epoch if beat else 0
+        step = beat.step if beat else 0
+        rc = exited.get(rank)
+        hung_detail = None
+        if beat is None:
+            if started_at is not None and now - started_at > spawn_timeout_s:
+                hung_detail = f"no beat within {spawn_timeout_s:.0f}s of launch"
+        elif now - beat.time > timeout_s:
+            hung_detail = f"last beat {now - beat.time:.1f}s ago"
+        elif (
+            progress_timeout_s > 0
+            and beat.timed  # steady state only — see Beat.timed
+            and now - beat.progress_time > progress_timeout_s
+        ):
+            hung_detail = (
+                f"no step progress for {now - beat.progress_time:.1f}s"
+            )
+        if rc is not None and rc != 0:
+            detail = f"signal {-rc}" if rc < 0 else f"exit {rc}"
+            out[rank] = RankHealth(rank, "dead", epoch, step, detail)
+        elif beat is not None and beat.status == "desynced":
+            out[rank] = RankHealth(
+                rank, "desynced", epoch, step, "step agreement diverged"
+            )
+        elif (
+            rc is None
+            and beat is not None
+            and frontier - beat.epoch > MAX_EPOCH_SKEW
+        ):
+            out[rank] = RankHealth(
+                rank, "desynced", epoch, step,
+                f"epoch {beat.epoch} vs live frontier {frontier}",
+            )
+        elif rc is None and hung_detail is not None:
+            out[rank] = RankHealth(rank, "hung", epoch, step, hung_detail)
+        else:
+            out[rank] = RankHealth(rank, "ok", epoch, step)
+    return out
+
+
+def format_failures(health: Dict[int, RankHealth]) -> List[str]:
+    """The single-line per-rank failure summary (docs/RELIABILITY.md):
+    ``rank R: <dead|hung|desynced> at epoch:step (detail)`` — what the
+    supervisor prints INSTEAD of every survivor's channel tracebacks."""
+    lines = []
+    for rank in sorted(health):
+        h = health[rank]
+        if not h.failed:
+            continue
+        detail = f" ({h.detail})" if h.detail else ""
+        lines.append(f"rank {h.rank}: {h.state} at {h.epoch}:{h.step}{detail}")
+    return lines

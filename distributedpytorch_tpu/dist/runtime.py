@@ -92,6 +92,25 @@ def _warm_host_collectives() -> None:
     multihost_utils.process_allgather(np.zeros((1,), np.int32))
 
 
+def _init_timeout_kwargs() -> dict:
+    """Bound the rendezvous wait (``DPT_DIST_INIT_TIMEOUT_S``, seconds).
+
+    jax's default initialization timeout is 300 s — fine for a pod
+    bring-up, far too patient for the elastic supervisor's relaunch
+    loop: a worker stuck joining a rendezvous whose peers already died
+    should fail fast so the supervisor can classify it and respawn the
+    whole world (dist/elastic.py sets this for its workers' children
+    only through the env, so standalone launches keep jax's default)."""
+    raw = os.environ.get("DPT_DIST_INIT_TIMEOUT_S")
+    if not raw:
+        return {}
+    try:
+        return {"initialization_timeout": int(float(raw))}
+    except ValueError:
+        logger.warning("ignoring malformed DPT_DIST_INIT_TIMEOUT_S=%r", raw)
+        return {}
+
+
 def initialize_from_env(force: bool = False) -> RuntimeInfo:
     """Initialize multi-process JAX if a launcher env is present.
 
@@ -107,7 +126,7 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
     # would stall startup.
     if os.environ.get("DPT_JAX_AUTO_INIT") == "1":
         _enable_cpu_collectives()
-        jax.distributed.initialize()
+        jax.distributed.initialize(**_init_timeout_kwargs())
         _INITIALIZED = True
         info = RuntimeInfo(jax.process_index(), jax.process_count(), None)
         if info.num_processes > 1:
@@ -137,6 +156,7 @@ def initialize_from_env(force: bool = False) -> RuntimeInfo:
         coordinator_address=info.coordinator,
         num_processes=info.num_processes,
         process_id=info.process_id,
+        **_init_timeout_kwargs(),
     )
     _INITIALIZED = True
     _warm_host_collectives()

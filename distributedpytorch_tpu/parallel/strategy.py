@@ -81,6 +81,18 @@ class Strategy:
         (DistributedSampler parity, reference train_utils.py:189)."""
         return ShardSpec(0, 1)
 
+    def topology(self) -> Dict[str, Any]:
+        """This strategy's mesh/process topology, as recorded in the
+        checkpoint manifest (checkpoint.save_topology fills the process/
+        device counts): the saving side of the mesh-resharding restore.
+        Keys are msgpack-plain (str → str/int)."""
+        mesh = (
+            {}
+            if self.mesh is None
+            else {str(k): int(v) for k, v in self.mesh.shape.items()}
+        )
+        return {"strategy": self.name, "mesh": mesh}
+
     # -- batch semantics ----------------------------------------------------
     @property
     def global_batch_size(self) -> int:

@@ -48,6 +48,7 @@ import json
 import logging
 import os
 import signal
+import time
 from typing import Optional
 
 import jax
@@ -126,6 +127,10 @@ class Trainer:
         # futures of in-flight async checkpoint writes; drained (and their
         # errors surfaced) when train() ends
         self._ckpt_futures = []
+        # per-rank heartbeat (dist/health.py): armed in train() when
+        # config.heartbeat_dir is set (the elastic supervisor's failure
+        # detector); None otherwise
+        self._heartbeat = None
 
         # model + state
         from distributedpytorch_tpu.models import create_model
@@ -311,6 +316,26 @@ class Trainer:
         restored = load_checkpoint(
             path, state.params, state.opt_state, state.model_state
         )
+        # Mesh-resharding restore (docs/RELIABILITY.md "Elastic runs"):
+        # checkpoints hold FULL host arrays (every sharded leaf was
+        # allgathered at save time), so restoring under a DIFFERENT
+        # topology — N→M processes after an elastic shrink, another
+        # strategy's mesh shape — just re-places them under the current
+        # sharding (place_state). Say so when it happens: a silent
+        # layout change is the kind of thing a post-incident reader
+        # needs one grep to find.
+        saved_topo = restored.get("topology")
+        if saved_topo is not None:
+            from distributedpytorch_tpu.checkpoint import save_topology
+
+            current_topo = {**save_topology(), **self.strategy.topology()}
+            if {k: saved_topo.get(k) for k in current_topo} != current_topo:
+                logger.warning(
+                    "mesh-resharding restore: checkpoint saved under %s, "
+                    "restoring onto %s — gathered host arrays re-placed "
+                    "under the current mesh",
+                    saved_topo, current_topo,
+                )
         new_state = state.replace(params=restored["params"], step=restored["step"])
         if restored["opt_state"] is not None:
             new_state = new_state.replace(opt_state=restored["opt_state"])
@@ -411,6 +436,7 @@ class Trainer:
             train_meta=self._train_meta(),
             keep=self.config.keep_checkpoints,
             write=self.strategy.is_main,
+            topology=self.strategy.topology(),
         )
         if fut is not None:
             self._ckpt_futures.append(fut)
@@ -439,6 +465,25 @@ class Trainer:
         }
 
     # -- step-level failure policies (docs/RELIABILITY.md) -------------------
+    def _finite_agreed(self, loss) -> bool:
+        """Policy ``skip``'s per-step finiteness check, made COLLECTIVE
+        on multi-process meshes: a non-finite loss can be rank-local (a
+        hardware bitflip on one chip, an injected ``nan_loss@R``), and a
+        rank that discards its update while its peers apply theirs has
+        silently forked the replicas — the exact divergence the policy
+        exists to prevent. One tiny allgather per step, only under
+        ``skip`` (which already pays a per-step host sync) and only with
+        >1 process; ANY rank non-finite → every rank discards."""
+        finite = bool(np.isfinite(float(loss)))
+        if jax.process_count() == 1:
+            return finite
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([0 if finite else 1], np.int32)
+        )
+        return not bool(np.any(flags))
+
     def _on_nonfinite_loss(self, step: int, value: float) -> None:
         """LossRecords' readback hook: a train loss drained to host came
         back NaN/Inf. Free on healthy runs — detection rides the drain
@@ -588,17 +633,40 @@ class Trainer:
         for sig, handler in self._prev_handlers.items():
             signal.signal(sig, handler)
 
-    def _stop_agreed(self) -> bool:
+    def _stop_agreed(self, global_step: int = -1) -> bool:
         """Collective stop decision: True iff ANY process saw a signal.
-        One tiny allgather per epoch — never called per step."""
+        One tiny allgather per epoch — never called per step.
+
+        The same allgather carries each rank's step counter — the
+        cross-rank step-agreement check of the elastic health layer
+        (dist/health.py): ranks that reach this epoch boundary at
+        DIFFERENT global steps are executing divergent programs (a
+        skipped update that wasn't agreed, a loader desync), which
+        would otherwise surface as replica drift or a wedged collective
+        far from the cause. On divergence every rank sees the same
+        allgathered evidence, so all mark their beat ``desynced`` (the
+        supervisor's classifier keys on it), log ONE line, and stop
+        together — an agreed teardown instead of a hang."""
         if jax.process_count() == 1:
             return self._stop_requested
         from jax.experimental import multihost_utils
 
         flags = multihost_utils.process_allgather(
-            np.asarray([1 if self._stop_requested else 0], np.int32)
+            np.asarray(
+                [1 if self._stop_requested else 0, int(global_step)],
+                np.int32,
+            )
         )
-        return bool(np.any(flags))
+        steps = flags[:, 1]
+        if global_step >= 0 and len(set(int(s) for s in steps)) > 1:
+            logger.error(
+                "rank %d: desynced at step agreement — per-rank steps %s",
+                jax.process_index(), [int(s) for s in steps],
+            )
+            if self._heartbeat is not None:
+                self._heartbeat.mark("desynced")
+            return True
+        return bool(np.any(flags[:, 0]))
 
     def train(self) -> dict:
         """Run the configured epochs; signal handlers are scoped to the run
@@ -608,6 +676,15 @@ class Trainer:
         Trainer from the checkpoint file, which must be fully on disk by
         then."""
         self._install_signal_handler()
+        if self.config.heartbeat_dir:
+            from distributedpytorch_tpu.dist.health import Heartbeat
+
+            self._heartbeat = Heartbeat(
+                self.config.heartbeat_dir,
+                jax.process_index(),
+                self.config.heartbeat_interval_s,
+            ).start()
+            self._heartbeat.update(self.start_epoch, int(self.state.step))
         ok = False
         try:
             result = self._run()
@@ -617,14 +694,27 @@ class Trainer:
             self._restore_signal_handler()
             if getattr(self, "_watchdog", None) is not None:
                 self._watchdog.stop()
-            # flush BEFORE draining checkpoints: a failed write raises out
-            # of the drain, and the final epoch's timeline spans are most
-            # valuable exactly when diagnosing that failing run
-            self.tracer.flush()
-            # the final drain is a HARD error boundary on a clean run: a
-            # failed write of the LAST save has no "next save" to surface
-            # it, so it must raise here, out of train() itself
-            self._drain_checkpoint_futures(raise_errors=ok)
+            try:
+                # flush BEFORE draining checkpoints: a failed write
+                # raises out of the drain, and the final epoch's
+                # timeline spans are most valuable exactly when
+                # diagnosing that failing run
+                self.tracer.flush()
+                if self._heartbeat is not None:
+                    # keep BEATING through the final drain (a long last
+                    # write must not read as a frozen process to the
+                    # supervisor's beat-age rule) but leave steady-state
+                    # timing: the drain makes no step progress and must
+                    # not trip the progress-timeout hang rule either
+                    self._heartbeat.timed = False
+                # the final drain is a HARD error boundary on a clean
+                # run: a failed write of the LAST save has no "next
+                # save" to surface it, so it must raise here, out of
+                # train() itself
+                self._drain_checkpoint_futures(raise_errors=ok)
+            finally:
+                if self._heartbeat is not None:
+                    self._heartbeat.stop()
 
     def _run(self) -> dict:
         cfg = self.config
@@ -695,7 +785,7 @@ class Trainer:
                         if faults.fire("nan_loss", epoch=epoch,
                                        step=global_step + 1):
                             loss = float("nan")  # forced step output
-                        if skip_guard and not np.isfinite(float(loss)):
+                        if skip_guard and not self._finite_agreed(loss):
                             # the one host sync per step this policy costs
                             self._skipped_steps += 1
                             logger.warning(
@@ -796,6 +886,17 @@ class Trainer:
                     # nothing, and a preemption grace window may be ticking.
                     with contextlib.closing(source):
                         for (kind, payload), placed in source:
+                            if self._heartbeat is not None:
+                                # attribute assignments only — the beat
+                                # FILE is written by the heartbeat's own
+                                # thread (dist/health.py): nothing here
+                                # blocks or syncs. `timed` mirrors the
+                                # watchdog's first-executed-epoch
+                                # exemption: the supervisor's
+                                # progress-timeout hang verdict applies
+                                # only in steady state.
+                                self._heartbeat.timed = epoch != untimed_epoch
+                                self._heartbeat.update(epoch, global_step)
                             if watchdog is not None:
                                 if epoch == untimed_epoch:
                                     # the first executed epoch compiles
@@ -824,10 +925,43 @@ class Trainer:
                             if faults.fire("sigterm", epoch=epoch,
                                            step=global_step):
                                 signal.raise_signal(signal.SIGTERM)
+                            # elastic chaos sites (docs/RELIABILITY.md
+                            # "Elastic runs"): kill or wedge THIS rank
+                            # mid-epoch, exactly how a preempted or
+                            # stuck peer presents to the supervisor's
+                            # health classifier. rank_kill is a real
+                            # SIGKILL — no handler, no checkpoint, no
+                            # atexit: the survivors' collectives are
+                            # genuinely abandoned.
+                            if faults.fire("rank_kill", epoch=epoch,
+                                           step=global_step):
+                                logger.error(
+                                    "injected rank_kill: SIGKILL rank %d "
+                                    "(pid %d) at %d:%d",
+                                    jax.process_index(), os.getpid(),
+                                    epoch, global_step,
+                                )
+                                os.kill(os.getpid(), signal.SIGKILL)
+                            if faults.fire("rank_hang", epoch=epoch,
+                                           step=global_step):
+                                hang_s = float(
+                                    os.environ.get("DPT_FAULT_HANG_S", "3600")
+                                )
+                                logger.error(
+                                    "injected rank_hang: rank %d step loop "
+                                    "sleeping %.0fs at %d:%d",
+                                    jax.process_index(), hang_s,
+                                    epoch, global_step,
+                                )
+                                time.sleep(hang_s)
                 if watchdog is not None:
                     watchdog.pause()
+                if self._heartbeat is not None:
+                    # epoch boundary: beats keep moving through the
+                    # (non-step) eval/checkpoint phases
+                    self._heartbeat.update(epoch, global_step)
 
-                if self._stop_agreed():
+                if self._stop_agreed(global_step):
                     # save a resumable snapshot at the last COMPLETED epoch
                     # — resume redoes the interrupted epoch from its start
                     # (the dedup guard is cleared: mid-epoch params/opt

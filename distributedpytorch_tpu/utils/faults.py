@@ -38,7 +38,15 @@ from typing import Callable, Dict, Optional, Sequence, Tuple
 logger = logging.getLogger(__name__)
 
 #: The named injection sites (one per recovery path under test).
-SITES = ("decode", "placement", "nan_loss", "ckpt_write", "sigterm")
+#: ``rank_kill`` (SIGKILL this process — the chaos input of the elastic
+#: supervisor's detect/relaunch path) and ``rank_hang`` (wedge the step
+#: loop in a long sleep — what a dead collective looks like from the
+#: host) fire in the step loop (train/loop.py) and are usually pinned to
+#: one rank with the ``site@RANK`` spec form.
+SITES = (
+    "decode", "placement", "nan_loss", "ckpt_write", "sigterm",
+    "rank_kill", "rank_hang",
+)
 
 
 class InjectedFault(Exception):
@@ -87,19 +95,44 @@ def is_transient(exc: BaseException) -> bool:
 @dataclasses.dataclass
 class FaultSpec:
     """One armed fault: fire at (epoch, step) — None = wildcard — up to
-    ``count`` times (-1 = unlimited)."""
+    ``count`` times (-1 = unlimited). ``rank`` pins the fault to one
+    process of a multi-process job (None = every rank): how chaos drills
+    kill/hang/poison exactly one peer of a live mesh."""
 
     site: str
     epoch: Optional[int] = None
     step: Optional[int] = None
     count: int = 1
+    rank: Optional[int] = None
+
+
+def _process_index() -> int:
+    """This process's rank, lazily (faults.py stays importable without
+    jax, and the backend may initialize after specs are armed)."""
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover — jax absent/uninitialized
+        return 0
 
 
 def parse_fault_spec(text: str) -> FaultSpec:
-    """Parse ``site:epoch:step[:count]``; ``*`` (or omitted) wildcards a
-    coordinate; count ``*`` means unlimited."""
+    """Parse ``site[@rank]:epoch:step[:count]``; ``*`` (or omitted)
+    wildcards a coordinate; count ``*`` means unlimited; ``@rank`` pins
+    the fault to one process (e.g. ``rank_kill@1:1:6``)."""
     parts = str(text).strip().split(":")
-    site = parts[0]
+    site, rank = parts[0], None
+    if "@" in site:
+        site, rank_text = site.split("@", 1)
+        try:
+            rank = int(rank_text)
+        except ValueError:
+            raise ValueError(
+                f"bad fault rank {rank_text!r} in {text!r}: site@RANK"
+            ) from None
+        if rank < 0:
+            raise ValueError(f"fault rank must be >= 0 in {text!r}")
     if site not in SITES:
         raise ValueError(
             f"unknown fault site {site!r}; expected one of {SITES}"
@@ -118,7 +151,9 @@ def parse_fault_spec(text: str) -> FaultSpec:
     )
     if count == 0 or count < -1:
         raise ValueError(f"bad fault count in {text!r} (>=1, or '*')")
-    return FaultSpec(site=site, epoch=coord(1), step=coord(2), count=count)
+    return FaultSpec(
+        site=site, epoch=coord(1), step=coord(2), count=count, rank=rank
+    )
 
 
 class FaultInjector:
@@ -145,6 +180,8 @@ class FaultInjector:
         with self._lock:
             for spec in self._specs:
                 if spec.site != site or spec.count == 0:
+                    continue
+                if spec.rank is not None and spec.rank != _process_index():
                     continue
                 if spec.epoch is not None and spec.epoch != epoch:
                     continue

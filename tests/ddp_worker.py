@@ -8,8 +8,27 @@ data x stage hybrid) and writes a params fingerprint plus replicated- and
 sharded-path val metrics per rank, so the parent can assert replicas stayed
 in sync through the gradient all-reduce and the sharded evaluator matches
 the replicated one.
+
+Modes (argv[3], default ``train``):
+  * ``train`` — the full train + report flow above;
+  * ``restore`` — NO training: build a Trainer that resumes from the
+    method's checkpoint (written by an earlier launch, possibly at a
+    DIFFERENT world size — the mesh-resharding restore path) and report
+    the restored params' sha256, so the parent can assert N→M restore is
+    parameter-bit-identical after gather;
+  * ``train_only`` — train, report, exit; NO post-train collectives
+    (eval equivalence, batch sums) and no distributed-shutdown barrier.
+    For chaos cases where a PEER is expected to die: the assertion is
+    that training's own collectives completed, and a survivor must not
+    be made to hang in report-time collectives its dead peer will never
+    join.
+
+Config overrides come as a JSON object in $DPT_WORKER_OVERRIDES (e.g.
+``{"nonfinite_policy": "skip", "inject_faults": ["nan_loss@1:0:3"]}``) —
+how the one-rank fault-injection tests arm a single peer of a live mesh.
 """
 
+import hashlib
 import json
 import os
 import sys
@@ -19,9 +38,24 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _params_sha256(tree) -> str:
+    """Bit-exact digest of a gathered host param tree (leaf order is
+    jax.tree's deterministic flattening)."""
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(tree):
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 def main():
     out_dir = sys.argv[1]
     method = sys.argv[2] if len(sys.argv) > 2 else "DDP"
+    mode = sys.argv[3] if len(sys.argv) > 3 else "train"
 
     from distributedpytorch_tpu.dist import initialize_from_env, shutdown
 
@@ -60,6 +94,68 @@ def main():
         metric_every_steps=1,
         num_workers=0,
     )
+    overrides = json.loads(os.environ.get("DPT_WORKER_OVERRIDES", "{}"))
+    if overrides:
+        import dataclasses
+
+        for key in ("inject_faults", "model_widths", "image_size"):
+            if key in overrides and overrides[key] is not None:
+                overrides[key] = tuple(overrides[key])
+        config = dataclasses.replace(config, **overrides)
+
+    from distributedpytorch_tpu.checkpoint import _to_host
+
+    rank = runtime.process_id
+
+    if mode == "restore":
+        # Mesh-resharding restore: resume the checkpoint some EARLIER
+        # world (possibly of different size) saved, and report the
+        # restored params bit-exactly. No training — the assertion is
+        # about the restore path alone.
+        import dataclasses
+
+        trainer = Trainer(dataclasses.replace(config, checkpoint_name=method))
+        with open(os.path.join(out_dir, f"restore_rank{rank}.json"), "w") as f:
+            json.dump(
+                {
+                    "rank": rank,
+                    "world": jax.process_count(),
+                    "start_epoch": trainer.start_epoch,
+                    "params_sha256": _params_sha256(_to_host(trainer.state.params)),
+                    "mesh_data": trainer.strategy.mesh.shape["data"],
+                },
+                f,
+            )
+        shutdown()
+        return
+
+    if mode == "train_only":
+        import traceback
+
+        trainer = Trainer(config)
+        err = None
+        result = None
+        try:
+            result = trainer.train()
+        except Exception as exc:  # noqa: BLE001 — reported to the parent
+            err = f"{type(exc).__name__}: {exc}"
+            traceback.print_exc()
+        with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
+            json.dump(
+                {
+                    "rank": rank,
+                    "error": err,
+                    "steps": result["steps"] if result else None,
+                    "skipped_steps": result["skipped_steps"] if result else None,
+                },
+                f,
+            )
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # no shutdown(): its coordination barrier would block on a peer
+        # that (by design of these chaos cases) may already be dead
+        os._exit(1 if err else 0)
+
     trainer = Trainer(config)
     result = trainer.train()
 
@@ -76,15 +172,20 @@ def main():
         trainer.val_loader,
         trainer.strategy.place_batch,
     )
-    assert trainer.grouped_eval_step is not None  # multi-process run
-    sh_loss, sh_dice = evaluate_sharded(
-        trainer.eval_step,
-        trainer.grouped_eval_step,
-        trainer._eval_variables(),
-        trainer.val_loader,
-        trainer.strategy.place_batch,
-        trainer.strategy.eval_shard(),
-    )
+    if jax.process_count() > 1:
+        assert trainer.grouped_eval_step is not None  # multi-process run
+        sh_loss, sh_dice = evaluate_sharded(
+            trainer.eval_step,
+            trainer.grouped_eval_step,
+            trainer._eval_variables(),
+            trainer.val_loader,
+            trainer.strategy.place_batch,
+            trainer.strategy.eval_shard(),
+        )
+    else:
+        # a world-1 launch (the reshard tests' save/restore anchors has
+        # no one to share eval with — the grouped path never builds
+        sh_loss, sh_dice = rep_loss, rep_dice
 
     # Batch-assembly consistency: the same jitted reduction of a placed
     # train batch must return the SAME value on every rank. Replica
@@ -106,8 +207,6 @@ def main():
     # gather is the one collective-safe way to materialize them — this
     # is also exactly what the save path runs, so the fingerprint
     # doubles as a check of the allgather itself
-    from distributedpytorch_tpu.checkpoint import _to_host
-
     params_host = _to_host(trainer.state.params)
     fingerprint = float(
         sum(float(np.abs(np.asarray(p)).sum()) for p in jax.tree.leaves(params_host))
@@ -138,16 +237,17 @@ def main():
             )
         )
 
-    rank = runtime.process_id
     with open(os.path.join(out_dir, f"rank{rank}.json"), "w") as f:
         json.dump(
             {
                 "rank": rank,
                 "fingerprint": fingerprint,
+                "params_sha256": _params_sha256(params_host),
                 "val_loss": result["val_loss"],
                 "replicated_val": [rep_loss, rep_dice],
                 "sharded_val": [sh_loss, sh_dice],
                 "steps": result["steps"],
+                "skipped_steps": result["skipped_steps"],
                 "mesh_data": trainer.strategy.mesh.shape["data"],
                 "batch_sum": batch_sum,
                 "non_addressable_leaves": non_addressable,

@@ -294,3 +294,42 @@ class TestMainLoop:
         assert mod._START > 0.0
         for k in ("BENCH_WGRAD_TAPS", "BENCH_ARCH", "BENCH_BATCH"):
             os.environ.pop(k, None)
+
+
+class TestSupervisorRestarts:
+    """Window reports carry the elastic supervisor's restart count, so a
+    flapping chip window (job survived via relaunches) reads differently
+    from a clean one."""
+
+    def test_reads_elastic_report(self, tmp_path, monkeypatch):
+        report = tmp_path / "report.json"
+        report.write_text(json.dumps({"restarts": 3, "final": "ok"}))
+        monkeypatch.setenv("DPT_ELASTIC_REPORT", str(report))
+        assert bench_multi.supervisor_restarts() == 3
+
+    def test_none_without_report(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(
+            "DPT_ELASTIC_REPORT", str(tmp_path / "missing.json"))
+        assert bench_multi.supervisor_restarts() is None
+
+    def test_session_lines_record_restarts(self, tmp_path, monkeypatch):
+        report = tmp_path / "report.json"
+        report.write_text(json.dumps({"restarts": 2}))
+        monkeypatch.setenv("DPT_ELASTIC_REPORT", str(report))
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {"BENCH_S2D_LEVELS": "0"}, 60.0)]
+        mod = TestMainLoop._fake_bench(None, [{"value": 1.0}])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+        assert bench_multi.main(["--out", out]) == 0
+        lines = [json.loads(x) for x in open(out) if x.strip()]
+        start = [d for d in lines if d.get("event") == "session_start"]
+        end = [d for d in lines if d.get("event") == "session_end"]
+        assert start[0]["supervisor_restarts"] == 2
+        assert end[0]["supervisor_restarts"] == 2 and end[0]["rc"] == 0
+
+    def test_none_when_env_unset(self, monkeypatch):
+        """No $DPT_ELASTIC_REPORT → None, never a guessed default path:
+        a stale report from some past drill must not stamp bogus restart
+        counts onto unrelated sessions."""
+        monkeypatch.delenv("DPT_ELASTIC_REPORT", raising=False)
+        assert bench_multi.supervisor_restarts() is None

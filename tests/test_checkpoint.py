@@ -63,6 +63,40 @@ class TestNativeCheckpoint:
         save_checkpoint(path, params)
         assert not (tmp_path / "c.msgpack.tmp").exists()
 
+    def test_topology_manifest_roundtrip(self, params, tmp_path):
+        """The mesh-resharding manifest: a save records its strategy/
+        mesh topology alongside the process/device counts, restore hands
+        it back (so `Trainer._restore` can announce an N→M reshard);
+        topology-less saves still report the ambient counts."""
+        path = str(tmp_path / "t.msgpack")
+        save_checkpoint(
+            path, params, topology={"strategy": "FSDP", "mesh": {"data": 4}}
+        )
+        topo = load_checkpoint(path, params)["topology"]
+        assert topo["strategy"] == "FSDP"
+        assert topo["mesh"] == {"data": 4}
+        assert topo["process_count"] == jax.process_count()
+        assert topo["device_count"] == jax.device_count()
+        save_checkpoint(path, params)  # no explicit topology
+        topo = load_checkpoint(path, params)["topology"]
+        assert topo["process_count"] == jax.process_count()
+
+    def test_pre_topology_checkpoint_returns_none(self, params, tmp_path):
+        import flax.serialization
+
+        path = str(tmp_path / "old.msgpack")
+        payload = {
+            "version": 1,
+            "params": flax.serialization.to_state_dict(
+                jax.tree.map(np.asarray, params)
+            ),
+            "opt_state": None, "scheduler": None, "step": 0, "epoch": 0,
+            "records": None, "model_state": None, "train_meta": None,
+        }
+        with open(path, "wb") as f:
+            f.write(flax.serialization.msgpack_serialize(payload))
+        assert load_checkpoint(path, params)["topology"] is None
+
 
 class TestReferenceInterop:
     def test_exported_key_names(self, params):

@@ -59,3 +59,20 @@ def test_additive_defaults_are_safe():
     assert args.prefetch_batches == 2
     assert args.max_restarts == 0
     assert args.synthetic == 0
+
+
+def test_elastic_worker_flags():
+    """The flags the elastic supervisor appends to every worker it
+    launches (dist/elastic.py) — off by default, parsed when present."""
+    args = _parse([])
+    assert args.heartbeat_dir is None
+    assert args.checkpoint_dir == "./checkpoints"
+    args = _parse(
+        ["--heartbeat-dir", "/tmp/hb", "--heartbeat-interval", "0.25",
+         "--checkpoint-dir", "/ckpts",
+         "--inject-fault", "rank_kill@1:1:6"]
+    )
+    assert args.heartbeat_dir == "/tmp/hb"
+    assert args.heartbeat_interval == 0.25
+    assert args.checkpoint_dir == "/ckpts"
+    assert args.inject_fault == ["rank_kill@1:1:6"]

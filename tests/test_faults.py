@@ -10,6 +10,7 @@ curve must be bit-identical to an uninjected run.
 
 import logging
 import os
+import threading
 import time
 
 import numpy as np
@@ -19,8 +20,10 @@ import pytest
 from distributedpytorch_tpu.checkpoint import (
     CheckpointCorruptError,
     load_checkpoint,
+    prune_retained,
     retained_checkpoints,
     save_checkpoint,
+    save_checkpoint_async,
     verify_checkpoint,
 )
 from distributedpytorch_tpu.config import TrainConfig
@@ -131,6 +134,34 @@ class TestSpecs:
         # different specs re-arm; empty disarms
         assert faults.install(()) is not inj
         assert not faults.fire("nan_loss", epoch=0, step=1)
+
+    def test_parse_rank_pinned_spec(self):
+        assert parse_fault_spec("rank_kill@1:1:6") == FaultSpec(
+            "rank_kill", epoch=1, step=6, count=1, rank=1
+        )
+        assert parse_fault_spec("rank_hang@0:*:2:*") == FaultSpec(
+            "rank_hang", epoch=None, step=2, count=-1, rank=0
+        )
+        # unpinned: every rank
+        assert parse_fault_spec("rank_kill:1:6").rank is None
+
+    def test_parse_rejects_bad_rank(self):
+        with pytest.raises(ValueError, match="rank"):
+            parse_fault_spec("rank_kill@x:1:1")
+        with pytest.raises(ValueError, match="rank"):
+            parse_fault_spec("rank_kill@-2:1:1")
+        with pytest.raises(ValueError, match="unknown fault site"):
+            parse_fault_spec("frobnicate@1:1:1")
+
+    def test_rank_pinned_spec_fires_only_on_its_rank(self):
+        """Single-process test env: jax.process_index() == 0 — an @0
+        spec fires here, an @1 spec never does (how the multi-process
+        chaos tests kill exactly one peer of a live mesh)."""
+        other = faults.FaultInjector(("nan_loss@1:*:*:*",))
+        assert not other.fire("nan_loss", epoch=0, step=1)
+        assert other.fired == {}
+        mine = faults.FaultInjector(("nan_loss@0:*:*:*",))
+        assert mine.fire("nan_loss", epoch=0, step=1)
 
 
 # ---------------------------------------------------------------------------
@@ -455,6 +486,75 @@ class TestCheckpointIntegrity:
         np.testing.assert_array_equal(restored["params"]["w"], self.PARAMS["w"])
 
 
+class TestRetentionPruneRace:
+    """`--keep-checkpoints` prune vs an in-flight async save: the
+    retention chain is shared mutable state between the writer thread
+    and external pruning, guarded by checkpoint._RETENTION_LOCK."""
+
+    PARAMS = {"w": np.arange(64, dtype=np.float32)}
+
+    def test_prune_blocks_behind_in_flight_rotate(self, tmp_path):
+        """Deterministic pin of the lock contract: while a writer holds
+        the retention critical section (rotate → rename → prune), an
+        external prune must WAIT — it can no longer delete the slot the
+        writer is rotating the previous checkpoint into."""
+        from distributedpytorch_tpu import checkpoint as ckpt
+
+        path = str(tmp_path / "race.ckpt")
+        assert ckpt._RETENTION_LOCK.acquire()
+        done = threading.Event()
+
+        def pruner():
+            prune_retained(path, 1)
+            done.set()
+
+        t = threading.Thread(target=pruner, daemon=True)
+        try:
+            t.start()
+            time.sleep(0.2)
+            assert not done.is_set()  # blocked behind the writer
+        finally:
+            ckpt._RETENTION_LOCK.release()
+        t.join(5.0)
+        assert done.is_set()
+
+    def test_prune_races_async_saves_without_losing_the_chain(self, tmp_path):
+        """Hammer prune_retained(keep=1) against a stream of queued
+        async saves (keep=2). Whatever the interleaving, the live slot
+        must end intact with the NEWEST payload and load_checkpoint must
+        succeed — without the lock, a prune landing between a save's
+        rotate and its rename could delete the only intact copy while
+        the live slot is mid-replacement."""
+        path = str(tmp_path / "race.ckpt")
+        save_checkpoint(path, self.PARAMS, epoch=0, keep=2)
+        stop = threading.Event()
+        errors = []
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    prune_retained(path, 1)
+                except Exception as exc:  # noqa: BLE001 — the assertion
+                    errors.append(exc)
+                    return
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            futures = [
+                save_checkpoint_async(path, self.PARAMS, epoch=i, keep=2)
+                for i in range(1, 21)
+            ]
+            for fut in futures:
+                fut.result(timeout=60)
+        finally:
+            stop.set()
+            t.join(5.0)
+        assert not errors
+        assert verify_checkpoint(path)
+        assert load_checkpoint(path, self.PARAMS)["epoch"] == 20
+
+
 # ---------------------------------------------------------------------------
 # dispatch watchdog
 # ---------------------------------------------------------------------------
@@ -546,6 +646,34 @@ class TestWatchdog:
         result = trainer.train()
         assert not trainer._watchdog.fired
         assert result["steps"] == 2 * 3  # ran to completion
+
+    def test_resumed_run_first_executed_epoch_is_untimed(self, tmp_path):
+        """Explicit pin of the exemption's ANCHOR: 'first executed
+        epoch' means start_epoch — NOT epoch index 0. A resumed run
+        compiles every executable shape all over again in its first
+        executed epoch (a fresh process has no warm executables), so a
+        refactor that re-times it would kill every elastic relaunch and
+        every --max-restarts recovery on a cold cache."""
+        Trainer(_config(tmp_path, epochs=1)).train()
+        cfg = _config(
+            tmp_path, epochs=2, checkpoint_name="singleGPU",
+            step_timeout_s=1.5,
+        )
+        trainer = Trainer(cfg)
+        assert trainer.start_epoch == 1  # genuinely resumed
+        orig_step = trainer.train_step
+        calls = {"n": 0}
+
+        def slow_step(state, batch):
+            calls["n"] += 1
+            if calls["n"] == 1:  # the resumed epoch's "compile"
+                time.sleep(3.0)
+            return orig_step(state, batch)
+
+        trainer.train_step = slow_step
+        result = trainer.train()
+        assert not trainer._watchdog.fired
+        assert result["steps"] == 2 * 3  # finished the resumed epoch
 
 
 # ---------------------------------------------------------------------------

@@ -33,7 +33,13 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _launch_world(tmp_path, world, local_devices, method):
+def _launch_world(tmp_path, world, local_devices, method, mode="train",
+                  overrides=None, expect_rc=None):
+    """Launch one N-rank world. ``overrides`` → $DPT_WORKER_OVERRIDES
+    (TrainConfig replacements, e.g. one-rank fault specs). ``expect_rc``
+    maps rank → expected nonzero exit (a rank whose configured policy is
+    SUPPOSED to fail); unlisted ranks must exit 0. Returns the per-rank
+    reports of ranks that exited 0, plus each rank's captured output."""
     port = _free_port()
     procs = []
     for rank in range(world):
@@ -58,9 +64,11 @@ def _launch_world(tmp_path, world, local_devices, method):
                 ),
             }
         )
+        if overrides:
+            env["DPT_WORKER_OVERRIDES"] = json.dumps(overrides)
         procs.append(
             subprocess.Popen(
-                [sys.executable, "-u", WORKER, str(tmp_path), method],
+                [sys.executable, "-u", WORKER, str(tmp_path), method, mode],
                 env=env,
                 cwd=REPO,
                 stdout=subprocess.PIPE,
@@ -83,14 +91,24 @@ def _launch_world(tmp_path, world, local_devices, method):
             if p.poll() is None:
                 p.kill()
                 p.wait()
+    expect_rc = expect_rc or {}
     for rank, (p, out) in enumerate(zip(procs, outputs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        want = expect_rc.get(rank, 0)
+        if want == 0:
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        else:
+            assert p.returncode != 0, (
+                f"rank {rank} was expected to fail but exited 0:\n{out}"
+            )
 
+    prefix = "restore_rank" if mode == "restore" else "rank"
     reports = []
     for rank in range(world):
-        with open(tmp_path / f"rank{rank}.json") as f:
+        if expect_rc.get(rank, 0) != 0:
+            continue
+        with open(tmp_path / f"{prefix}{rank}.json") as f:
             reports.append(json.load(f))
-    return reports
+    return reports, outputs
 
 
 def _assert_world(tmp_path, reports, method, mesh_data):
@@ -135,7 +153,7 @@ def test_two_process(tmp_path, method, mesh_data):
     {data:2, stage:2} — crosses jax.distributed with the explicit pipeline
     schedule (VERDICT r03 next-8). DDP_SP: {data:2, spatial:2} — the
     H-sliced batch placement over jax.distributed."""
-    reports = _launch_world(tmp_path, world=2, local_devices=2, method=method)
+    reports, _ = _launch_world(tmp_path, world=2, local_devices=2, method=method)
     _assert_world(tmp_path, reports, method, mesh_data)
 
 
@@ -148,13 +166,95 @@ def test_two_process_fsdp_save_restore(tmp_path):
     (checkpoint._to_host; ROADMAP 'Multi-host-safe sharded checkpoint
     gather'). The worker proves the save restores bit-identically into a
     fresh sharded Trainer on every rank."""
-    reports = _launch_world(tmp_path, world=2, local_devices=2, method="FSDP")
+    reports, _ = _launch_world(tmp_path, world=2, local_devices=2, method="FSDP")
     _assert_world(tmp_path, reports, "FSDP", 4)
     for r in reports:
         # the premise: state actually spans processes (else this test
         # degenerates to the single-host path)
         assert r["non_addressable_leaves"] > 0, r
         assert r["restore_ok"] is True, r
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("save_world,restore_world", [(2, 1), (1, 2)])
+def test_fsdp_reshard_restore(tmp_path, save_world, restore_world):
+    """Mesh-resharding restore (the elastic tentpole's acceptance
+    criterion): a checkpoint saved on an N-process FSDP mesh restores
+    onto an M-process mesh — N→M (a shrunk elastic relaunch) AND M→N (a
+    recovered slot) — parameter-BIT-identical after gather. Checkpoints
+    hold full host arrays (`_to_host` allgathers sharded leaves at save
+    time), so restore just re-places them under the current sharding;
+    this proves that end to end across actual world sizes."""
+    save_reports, _ = _launch_world(
+        tmp_path, world=save_world, local_devices=2, method="FSDP"
+    )
+    trained_hash = save_reports[0]["params_sha256"]
+    assert all(r["params_sha256"] == trained_hash for r in save_reports)
+
+    restore_reports, _ = _launch_world(
+        tmp_path, world=restore_world, local_devices=2, method="FSDP",
+        mode="restore",
+    )
+    assert len(restore_reports) == restore_world
+    for r in restore_reports:
+        assert r["start_epoch"] == 1, r  # resumed, not fresh
+        assert r["params_sha256"] == trained_hash, (
+            f"reshard {save_world}→{restore_world}: restored params "
+            f"differ from the saved ones"
+        )
+
+
+@pytest.mark.slow
+def test_one_rank_decode_fault_recovers_in_lockstep(tmp_path):
+    """PR 2's transient decode injection, fired on ONE rank of a live
+    2-process mesh: the bounded-backoff retry recovers locally, the
+    survivor never waits on a desynced collective, and both ranks end
+    bit-identical (the transparent-recovery contract, now multi-proc)."""
+    reports, _ = _launch_world(
+        tmp_path, world=2, local_devices=1, method="DDP",
+        overrides={"inject_faults": ["decode@1:0:*"]},
+    )
+    _assert_world(tmp_path, reports, "DDP", 2)
+    assert reports[0]["steps"] == reports[1]["steps"]
+
+
+@pytest.mark.slow
+def test_one_rank_nan_skip_is_agreed_collectively(tmp_path):
+    """``nan_loss`` injected on rank 1 ONLY, policy ``skip``: without
+    the collective finiteness agreement (train/loop._finite_agreed) the
+    injected rank discards its update while its peer applies one —
+    silently forked replicas. With it, BOTH ranks discard the same step:
+    equal step counts, equal skip counts, bit-identical fingerprints."""
+    reports, _ = _launch_world(
+        tmp_path, world=2, local_devices=1, method="DDP",
+        overrides={
+            "nonfinite_policy": "skip",
+            "inject_faults": ["nan_loss@1:0:3"],
+        },
+    )
+    _assert_world(tmp_path, reports, "DDP", 2)
+    assert [r["skipped_steps"] for r in reports] == [1, 1]
+    assert reports[0]["steps"] == reports[1]["steps"]
+    assert reports[0]["fingerprint"] == reports[1]["fingerprint"]
+
+
+@pytest.mark.slow
+def test_ckpt_write_fault_fails_writer_without_hanging_survivor(tmp_path):
+    """``ckpt_write`` on a 2-process mesh fires only on the writing rank
+    (rank 0). The torn write surfaces as a hard error out of rank 0's
+    final drain — AFTER the run's last collective — so rank 1 completes
+    cleanly and neither rank hangs in a collective (the launch's 1800 s
+    communicate() timeout is the no-hang oracle)."""
+    reports, outputs = _launch_world(
+        tmp_path, world=2, local_devices=1, method="DDP", mode="train_only",
+        overrides={"inject_faults": ["ckpt_write:1"], "keep_checkpoints": 1},
+        expect_rc={0: 1},
+    )
+    assert "injected ckpt_write fault" in outputs[0]
+    # the survivor (rank 1) finished its full run and reported
+    assert len(reports) == 1 and reports[0]["rank"] == 1
+    assert reports[0]["error"] is None
+    assert reports[0]["steps"] > 0
 
 
 @pytest.mark.slow
@@ -168,5 +268,5 @@ def test_four_process(tmp_path, method, mesh_data):
     into replicated/H-sliced shards (the row-based data_shard contract)
     and the collectives cross process boundaries; the sharded
     evaluator's grouped dispatch executes at its row world."""
-    reports = _launch_world(tmp_path, world=4, local_devices=1, method=method)
+    reports, _ = _launch_world(tmp_path, world=4, local_devices=1, method=method)
     _assert_world(tmp_path, reports, method, mesh_data)

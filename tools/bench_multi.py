@@ -129,6 +129,25 @@ def append_line(path: str, obj: dict) -> None:
         os.fsync(f.fileno())
 
 
+def supervisor_restarts(path: str = "") -> "int | None":
+    """Restart count from the elastic supervisor's report JSON
+    (dist/elastic.py writes it; path via $DPT_ELASTIC_REPORT), or None
+    when no supervisor is wired in. Recorded in the window's session
+    lines so a FLAPPING chip window — the job survived only because the
+    supervisor kept relaunching it — is distinguishable from a clean
+    one when reading the A/B numbers. Explicit opt-in only: guessing a
+    default path would stamp STALE restart counts from some past drill
+    onto unrelated sessions, the exact misread this field prevents."""
+    path = path or os.environ.get("DPT_ELASTIC_REPORT", "")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            return int(json.load(f).get("restarts", 0))
+    except (OSError, ValueError, TypeError, AttributeError):
+        return None
+
+
 def load_state(path: str) -> dict:
     """Parse the artifact into {config_name: status}.
 
@@ -279,9 +298,14 @@ def main(argv=None) -> int:
 
     probe = _probe_once(args.probe_timeout)
     append_line(args.out, {"event": "session_start", "probe": probe,
-                           "todo": [n for n, _, _ in todo]})
+                           "todo": [n for n, _, _ in todo],
+                           "supervisor_restarts": supervisor_restarts()})
     if not probe.get("ok"):
         print(f"bench_multi: runtime dead at start: {probe}")
+        append_line(args.out, {
+            "event": "session_end", "rc": 2,
+            "supervisor_restarts": supervisor_restarts(),
+        })
         return 2
 
     import bench
@@ -349,6 +373,10 @@ def main(argv=None) -> int:
                 continue
             print(f"bench_multi: runtime died at config {name!r}: "
                   f"{exc}")
+            append_line(args.out, {
+                "event": "session_end", "rc": 4,
+                "supervisor_restarts": supervisor_restarts(),
+            })
             return 4
         dog.cancel()
         append_line(args.out, {"config": name, **result})
@@ -358,7 +386,12 @@ def main(argv=None) -> int:
     state = load_state(args.out)
     unresolved = [n for n, _, _ in CONFIGS
                   if state.get(n) in (None, "innocent")]
-    return 1 if unresolved else 0
+    rc = 1 if unresolved else 0
+    append_line(args.out, {
+        "event": "session_end", "rc": rc, "unresolved": unresolved,
+        "supervisor_restarts": supervisor_restarts(),
+    })
+    return rc
 
 
 if __name__ == "__main__":
