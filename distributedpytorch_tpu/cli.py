@@ -63,6 +63,12 @@ def get_args():
                              "indices (L encoder levels, mid, L decoder "
                              "levels+head); default: faithful 2-stage cut, "
                              "even split otherwise")
+    parser.add_argument("--pipeline-schedule", type=str, default="gpipe",
+                        choices=["gpipe", "1f1b"],
+                        help="MP/DDP_MP schedule: gpipe (fill-drain; "
+                             "activation memory grows with --microbatches) "
+                             "or 1f1b (PipeDream-flush; in-flight memory "
+                             "bounded by --stages, grad-equivalent)")
     parser.add_argument("--num-workers", type=int, default=4,
                         help="Host-side decode threads")
     parser.add_argument("--prefetch-batches", type=int, default=2,
@@ -234,6 +240,7 @@ def main():
         num_microbatches=args.microbatches,
         num_stages=args.stages,
         pipeline_cuts=tuple(args.pipeline_cuts) if args.pipeline_cuts else None,
+        pipeline_schedule=args.pipeline_schedule,
         num_workers=args.num_workers,
         prefetch_batches=args.prefetch_batches,
         host_cache_mb=args.host_cache_mb,

@@ -71,6 +71,20 @@ class TrainConfig:
     # L encoder levels, mid, L decoder levels+head). None = the faithful
     # 2-stage cut for S=2, an even split otherwise.
     pipeline_cuts: Optional[Tuple[int, ...]] = None
+    # Pipeline schedule (parallel/pipeline.py):
+    #   "gpipe" — fill-drain, differentiated through the shard_map; peak
+    #             activation memory grows linearly with num_microbatches
+    #             (every microbatch's stage activations stay live until
+    #             the backward drains);
+    #   "1f1b"  — PipeDream-flush: explicit per-tick vjp backward with at
+    #             most ~S in-flight microbatches per stage, so peak
+    #             activation memory is bounded by the stage count and M
+    #             becomes a free throughput lever (the M=8/16 rows that
+    #             OOM or remat under gpipe at batch 4). Grad-equivalent
+    #             to gpipe (tests/test_pipeline_1f1b.py).
+    # Default gpipe until the on-chip A/B lands (tools/bench_pipeline.py
+    # --schedule sweep / bench_multi pipeline config).
+    pipeline_schedule: str = "gpipe"
 
     # -- precision ----------------------------------------------------------
     # bfloat16 keeps the MXU fed; params and loss stay float32.
@@ -90,7 +104,11 @@ class TrainConfig:
     # the reference config (the full-res C=32/64 convs starve the 128-lane
     # MXU; their s2d forms don't). -1 = auto: 2 on a TPU backend, 0 elsewhere
     # (the rewrite's 4× nominal MACs only pay off on the MXU).
-    # 0 = plain pixel-domain execution.
+    # 0 = plain pixel-domain execution. Explicit 3 is supported and proven
+    # exact (tests/test_s2d.py level-3 cases, both model families) — a
+    # re-measure lever for geometries where level 3 still starves the MXU;
+    # auto stays at 2 (level 3 regressed at the reference geometry,
+    # docs/PERFORMANCE.md).
     s2d_levels: int = -1
     # Compute the s2d 3×3 convs' weight gradients as 9 tap matmuls
     # (ops/conv_backward.py) instead of XLA's conv-backward-filter —

@@ -300,7 +300,8 @@ class MilesialUNet(nn.Module):
     s2d_levels: int = -1
     wgrad_taps: bool = False
 
-    # train/steps.py keys off this to thread the batch_stats collection
+    # train/steps.py and parallel/pipeline.py key off this to thread the
+    # batch_stats collection
     is_stateful = True
 
     def _s2d_levels(self) -> int:
@@ -316,14 +317,19 @@ class MilesialUNet(nn.Module):
             )
         return lv
 
-    @nn.compact
+    # -- pipeline segments (parallel/pipeline.py) ---------------------------
+    # The family's linear block order: inc, L Down levels, L Up levels with
+    # the 1×1 outc head folded into the last — 2L+1 segments, the same
+    # carry convention as models/unet.py (encoder segments push skips,
+    # decoder segments pop; inc's output IS its own skip, milesial-style).
+    @property
+    def num_segments(self) -> int:
+        return 2 * (len(self.widths) - 1) + 1
+
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
-        w = tuple(self.widths)
-        assert len(w) >= 2, "milesial needs at least inc + one Down level"
-        factor = 2 if self.bilinear else 1
         lv = self._s2d_levels()
         if lv > 0:
-            div = 2 ** (len(w) - 1)
+            div = 2 ** (len(self.widths) - 1)
             h_, w_ = x.shape[1], x.shape[2]
             if h_ % div or w_ % div:
                 if self.s2d_levels < 0:
@@ -338,78 +344,133 @@ class MilesialUNet(nn.Module):
                         f"mode requires — resize the input or pass "
                         f"s2d_levels=0 (CLI: --s2d-levels 0)"
                     )
+        x, _skips = self._apply_segments(x, (), 0, self.num_segments, train, lv)
+        return x
 
-        n_downs = len(w) - 1  # also the number of Ups
-        if lv > 0:
-            xs = s2d_ops.space_to_depth(x)
-            x = DoubleConvS2D(
-                w[0], in_features=x.shape[-1], dtype=self.dtype,
-                wgrad_taps=self.wgrad_taps, name="inc",
-            )(xs, train)
-        else:
-            x = DoubleConv(
-                w[0], dtype=self.dtype, wgrad_taps=self.wgrad_taps, name="inc"
-            )(x, train)
-        skips = [x]
-        for i, feats in enumerate(w[1:-1]):
-            level = i + 1
-            if level < lv or (level == lv and lv > 0):
-                # s2d level, or the boundary Down whose pool consumes an
-                # s2d input (group_max) but convs in the pixel domain
-                x = _DownS2D(
-                    feats, in_features=w[level - 1],
-                    prev_s2d=level - 1 < lv, this_s2d=level < lv,
-                    dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-                    name=f"down{level}",
-                )(x, train)
-            else:
-                x = Down(
-                    feats, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-                    name=f"down{level}",
-                )(x, train)
-            skips.append(x)
-        last = len(w) - 1
-        if last == lv and lv > 0:
-            x = _DownS2D(
-                w[-1] // factor, in_features=w[last - 1],
-                prev_s2d=True, this_s2d=False,
-                dtype=self.dtype, name=f"down{last}",
-            )(x, train)
-        else:
-            x = Down(
-                w[-1] // factor, dtype=self.dtype,
-                wgrad_taps=self.wgrad_taps, name=f"down{last}",
-            )(x, train)
-        for i, (feats, skip) in enumerate(zip(reversed(w[:-1]), reversed(skips))):
-            out_feats = feats // (factor if i < len(w) - 2 else 1)
-            if i >= n_downs - lv:
-                # shallowest lv Ups: skip is s2d-form, output stays s2d
-                x = _UpS2D(
-                    out_feats,
-                    skip_features=w[n_downs - 1 - i],
-                    prev_s2d=i - 1 >= n_downs - lv,
-                    dtype=self.dtype,
-                    wgrad_taps=self.wgrad_taps,
-                    name=f"up{i + 1}",
-                )(x, skip, train)
-            else:
-                x = Up(
-                    out_feats,
-                    bilinear=self.bilinear,
-                    dtype=self.dtype,
-                    wgrad_taps=self.wgrad_taps,
-                    name=f"up{i + 1}",
-                )(x, skip, train)
-        if lv > 0:
-            x = _S2DConv(
-                self.n_classes, w[0], "head", dtype=self.dtype, name="outc"
-            )(x)
-            x = s2d_ops.depth_to_space(x)
-        else:
-            x = nn.Conv(self.n_classes, (1, 1), dtype=self.dtype, name="outc")(x)
-        if self.n_classes == 1:
-            return jax.nn.sigmoid(x.astype(jnp.float32))
-        return x.astype(jnp.float32)
+    def apply_segment(
+        self, x: jax.Array, skips: Tuple[jax.Array, ...], seg: int,
+        train: bool = False,
+    ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Run segment ``seg`` (static int) of the linear block order —
+        the stateful `(params, batch_stats) → (y, batch_stats')` path the
+        pipeline schedules thread: apply with ``mutable=['batch_stats']``
+        and ``train=True`` to get this segment's BatchNorm updates
+        (batch statistics are per-microbatch, GPipe-style; the schedule
+        psums the running-stat deltas across the stage axis).
+
+        The s2d execution domain of every segment is a static function of
+        the CONFIGURED level count, so stages can start at any segment
+        without threading domain state; a ragged input therefore fails
+        fast here (the full forward's auto-degrade would silently pick a
+        different domain per stage)."""
+        lv = self._s2d_levels()
+        if seg == 0 and lv > 0:
+            div = 2 ** (len(self.widths) - 1)
+            h_, w_ = x.shape[1], x.shape[2]
+            if h_ % div or w_ % div:
+                raise ValueError(
+                    f"input {h_}×{w_} is not divisible by {div} "
+                    f"(2**levels), which the space-to-depth execution mode "
+                    f"requires under the pipeline schedule — resize the "
+                    f"input or pass s2d_levels=0 (CLI: --s2d-levels 0)"
+                )
+        return self._apply_segments(x, tuple(skips), seg, seg + 1, train, lv)
+
+    @nn.compact
+    def _apply_segments(
+        self,
+        x: jax.Array,
+        skips: Tuple[jax.Array, ...],
+        first: int,
+        last: int,
+        train: bool,
+        lv: int,
+    ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
+        """Segments [first, last) of the linear block order. Module names
+        ("inc", "down{i}", "up{i}", "outc") are explicit, so any segment
+        subset builds the same parameter tree entries as the full forward
+        — what lets `apply_segment` run one segment against the full
+        variables dict."""
+        w = tuple(self.widths)
+        assert len(w) >= 2, "milesial needs at least inc + one Down level"
+        factor = 2 if self.bilinear else 1
+        L = len(w) - 1  # downs; also the number of Ups
+        skips = tuple(skips)
+        for seg in range(first, last):
+            if seg == 0:  # inc stem; its output is also the first skip
+                if lv > 0:
+                    xs = s2d_ops.space_to_depth(x)
+                    x = DoubleConvS2D(
+                        w[0], in_features=x.shape[-1], dtype=self.dtype,
+                        wgrad_taps=self.wgrad_taps, name="inc",
+                    )(xs, train)
+                else:
+                    x = DoubleConv(
+                        w[0], dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                        name="inc",
+                    )(x, train)
+                skips = skips + (x,)
+            elif seg <= L:  # Down level `seg`
+                level = seg
+                feats = w[level] // (factor if level == L else 1)
+                if level < lv or (level == lv and lv > 0):
+                    # s2d level, or the boundary Down whose pool consumes
+                    # an s2d input (group_max) but convs in the pixel
+                    # domain
+                    x = _DownS2D(
+                        feats, in_features=w[level - 1],
+                        prev_s2d=level - 1 < lv, this_s2d=level < lv,
+                        dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                        name=f"down{level}",
+                    )(x, train)
+                else:
+                    x = Down(
+                        feats, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
+                        name=f"down{level}",
+                    )(x, train)
+                if level < L:  # the deepest Down is the bottleneck, no skip
+                    skips = skips + (x,)
+            else:  # Up level; the last one carries outc + activation
+                i = seg - L - 1  # 0-based Up index
+                feats = w[L - 1 - i]
+                out_feats = feats // (factor if i < L - 1 else 1)
+                skip = skips[-1]
+                skips = skips[:-1]
+                if i >= L - lv:
+                    # shallowest lv Ups: skip is s2d-form, output stays s2d
+                    x = _UpS2D(
+                        out_feats,
+                        skip_features=feats,
+                        prev_s2d=i - 1 >= L - lv,
+                        dtype=self.dtype,
+                        wgrad_taps=self.wgrad_taps,
+                        name=f"up{i + 1}",
+                    )(x, skip, train)
+                else:
+                    x = Up(
+                        out_feats,
+                        bilinear=self.bilinear,
+                        dtype=self.dtype,
+                        wgrad_taps=self.wgrad_taps,
+                        name=f"up{i + 1}",
+                    )(x, skip, train)
+                if seg == 2 * L:
+                    if lv > 0:
+                        x = _S2DConv(
+                            self.n_classes, w[0], "head", dtype=self.dtype,
+                            name="outc",
+                        )(x)
+                        x = s2d_ops.depth_to_space(x)
+                    else:
+                        x = nn.Conv(
+                            self.n_classes, (1, 1), dtype=self.dtype,
+                            name="outc",
+                        )(x)
+                    if self.n_classes == 1:
+                        x = jax.nn.sigmoid(x.astype(jnp.float32))
+                    else:
+                        x = x.astype(jnp.float32)
+        return x, skips
 
 
 def init_milesial(

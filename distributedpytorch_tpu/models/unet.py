@@ -457,7 +457,8 @@ class UNet(nn.Module):
         return 2 * len(self.widths) + 1
 
     def apply_segment(
-        self, x: jax.Array, skips: Tuple[jax.Array, ...], seg: int
+        self, x: jax.Array, skips: Tuple[jax.Array, ...], seg: int,
+        train: bool = False,
     ) -> Tuple[jax.Array, Tuple[jax.Array, ...]]:
         """Run segment ``seg`` (static int) of the linear block order.
 
@@ -465,6 +466,11 @@ class UNet(nn.Module):
         outputs produced so far and not yet consumed — segments push during
         encode, pop (deepest-first) during decode, so the inter-stage
         payload at any cut is exactly this carry.
+
+        ``train`` is the uniform segment signature shared with the
+        stateful family (models/milesial.py `apply_segment`, where it
+        selects batch-vs-running statistics); this model is stateless, so
+        it is accepted and ignored.
         """
         L = len(self.widths)
         if seg == 0:

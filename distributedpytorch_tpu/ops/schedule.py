@@ -69,5 +69,27 @@ class ReduceLROnPlateau:
         return dataclasses.asdict(self)
 
     def load_state_dict(self, state: dict) -> None:
-        for k, v in state.items():
-            setattr(self, k, v)
+        """Restore from `state_dict()` output (or a legacy subset of it).
+
+        Unknown keys are rejected loudly — silently setattr'ing them
+        (the old behavior) let a typo'd or stale checkpoint field ride
+        along as a dead attribute. Missing keys keep their constructor
+        values (legacy checkpoints predate some fields). A legacy dict
+        that carries ``best=None`` (saved before the first `step()` ever
+        ran under an old version that serialized the pre-__post_init__
+        placeholder) re-derives the mode-correct sentinel instead of
+        poisoning every later `_is_better` comparison with a
+        None-vs-float TypeError."""
+        known = {f.name for f in dataclasses.fields(self)}
+        unknown = set(state) - known
+        if unknown:
+            raise ValueError(
+                f"ReduceLROnPlateau.load_state_dict: unknown keys "
+                f"{sorted(unknown)} (expected a subset of {sorted(known)})"
+            )
+        # validate on a candidate copy first (replace() re-runs
+        # __post_init__: mode check + best-sentinel derivation), so a bad
+        # value leaves this scheduler untouched — no half-applied state
+        # for a caller that catches the error
+        candidate = dataclasses.replace(self, **state)
+        self.__dict__.update(candidate.__dict__)

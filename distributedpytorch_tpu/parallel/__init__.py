@@ -19,5 +19,7 @@ from distributedpytorch_tpu.parallel.strategy import (  # noqa: F401
     build_strategy,
 )
 from distributedpytorch_tpu.parallel.pipeline import (  # noqa: F401
+    PIPELINE_SCHEDULES,
     make_pipeline_loss_fn,
+    make_pipeline_value_and_grad_fn,
 )

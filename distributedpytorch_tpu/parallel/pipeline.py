@@ -1,4 +1,4 @@
-"""GPipe-style microbatched pipeline over a 'stage' mesh axis, S stages.
+"""Microbatched pipeline schedules over a 'stage' mesh axis, S stages.
 
 TPU-native re-design of the reference's hand-written 2-GPU pipeline
 (reference model/unet_model.py:14-53). The reference gets overlap for free
@@ -11,12 +11,10 @@ over schedule ticks, `lax.cond` selecting each device's stage work, and
 
 Generalized from the round-3 two-stage schedule to S stages (VERDICT r03
 next-3): the model exposes its linear block order as 2L+1 segments
-(models/unet.py `UNet.apply_segment`), a stage is any contiguous run of
-segments, and ``cuts`` picks the boundaries. The default for S=2 is the
-faithful reference cut (encoder+mid | decoder+head, unet_model.py:16-20);
-for S>2 segments are split evenly. Schedule shape: M microbatches over
-M + S − 1 ticks — the standard (S−1)-tick warmup/drain bubble, amortized by
-raising M.
+(models/unet.py `UNet.apply_segment`, models/milesial.py the same), a stage
+is any contiguous run of segments, and ``cuts`` picks the boundaries. The
+default for S=2 is the faithful reference cut (encoder+mid | decoder+head,
+unet_model.py:16-20); for S>2 segments are split evenly.
 
 Skip connections cross stages: encoder segments push skip tensors onto the
 carry, decoder segments pop them, so the payload on the edge between stages
@@ -26,17 +24,67 @@ Each edge has its own payload shapes; every device materializes every
 edge's (zero) buffer, but only the owning stage's is nonzero, and
 ``lax.cond`` keeps the inactive stage computations unexecuted on TPU.
 
-Differentiation: the whole schedule is a pure function of the (replicated)
-params, so `jax.grad` through the `shard_map` gives the pipelined backward
-automatically — `ppermute`'s transpose is the reverse permute, so activation
-cotangents flow stage s+1 → s with the same overlap structure. Parameters
-are replicated across the stage axis (30 MB of params — replication is the
-right trade; what is *pipelined* is the activation traffic, which at
-(µB,640,960,32) per skip is the dominant term exactly as in the reference).
+Two schedules (``TrainConfig.pipeline_schedule``):
 
-The ('data', 'stage') hybrid falls out for free: batch sharded over 'data',
-schedule over 'stage'; `jax.grad`'s transpose inserts the gradient psum over
-'data' — that psum is the DDP all-reduce.
+``gpipe`` — fill-drain: M microbatches over M+S−1 forward ticks; the whole
+schedule is a pure function of the (replicated) params, so `jax.grad`
+through the `shard_map` gives the pipelined backward automatically —
+`ppermute`'s transpose is the reverse permute, so activation cotangents
+flow stage s+1 → s with the same overlap structure. The price is GPipe's
+memory profile (Huang et al., 2019): autodiff saves every microbatch's
+stage activations across all M+S−1 ticks, so peak activation memory grows
+linearly in M — raising M to amortize the (S−1)-tick bubble is exactly
+what runs out of HBM first.
+
+``1f1b`` — PipeDream-flush (Narayanan et al., 2021), built in
+`make_pipeline_value_and_grad_fn`: an explicit backward schedule whose
+steady-state ticks alternate one-forward-one-backward, holding at most
+S−s in-flight microbatches at stage s — peak activation memory is bounded
+by S, independent of M, which turns M from a memory liability into a free
+throughput lever. Two wrinkles specific to this codebase:
+
+  * The loss is NOT microbatch-additive (the log-dice term is a ratio of
+    whole-batch sums, reference utils/utils.py:18-23), so the activation
+    cotangent entering ANY backward depends on the psummed whole-batch
+    stats — no backward may start before every forward has run. The
+    schedule therefore runs two phases inside one shard_map: a
+    forward-only stats pass (differentiated by nothing, so XLA frees its
+    activations tick by tick), then the 1F1B forward/backward pass
+    against the now-known global stats cotangent. The extra forward pass
+    is the same price `make_accum_train_step` documents for exact
+    accumulation under a non-additive loss.
+  * `jax.vjp` residuals are function closures, which cannot cross
+    `lax.cond`/`ppermute` as carried state — so the residual carried
+    from a stage's forward tick to its backward tick is the stage's
+    INPUT payload (the cut carry: bottleneck + pending skips), and the
+    backward tick runs `jax.vjp` on the stage from that carry
+    (per-stage rematerialization). In-flight state per stage is ≈S−s
+    cut carries; the full conv activations exist only transiently
+    inside the single backward tick's own VJP.
+
+Per-stage weight gradients accumulate across microbatches in float32 and
+one explicit `psum` over ('stage'[, 'data']) closes the hybrid: each
+stage's params-gradient leaves are nonzero only for its own segments, so
+the stage-psum assembles the full gradient and the data-psum is the DDP
+all-reduce (the same reduction `jax.grad`'s transpose inserts for the
+gpipe schedule).
+
+BatchNorm threads through both schedules (models/milesial.py): stage
+functions take ``(params, batch_stats, x, skips) → ((x, skips),
+batch_stats')`` and each stage applies its segments with
+``mutable=['batch_stats']`` per microbatch, in microbatch order — GPipe's
+published BatchNorm treatment (statistics over each microbatch; running
+stats updated per microbatch). Only the owning stage's layers move, so the
+final running stats are assembled by psumming each leaf's DELTA across the
+stage axis (zeros elsewhere — the stage-axis psum of microbatch moments);
+on a hybrid mesh the deltas are additionally pmean'ed over 'data' (each
+data replica saw its own shard — torch-DDP-default local-BN semantics,
+averaged into one replicated running-stats tree).
+
+Parameters are replicated across the stage axis (30 MB of params —
+replication is the right trade; what is *pipelined* is the activation
+traffic, which at (µB,640,960,32) per skip is the dominant term exactly as
+in the reference).
 """
 
 from __future__ import annotations
@@ -51,6 +99,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributedpytorch_tpu.utils.compat import shard_map
 
 from distributedpytorch_tpu.ops.losses import bce_dice_stats, loss_from_stats
+
+PIPELINE_SCHEDULES = ("gpipe", "1f1b")
 
 
 def default_cuts(num_segments: int, num_stages: int) -> Tuple[int, ...]:
@@ -95,12 +145,14 @@ def _stage_ranges(
     return [range(bounds[s], bounds[s + 1]) for s in range(num_stages)]
 
 
-def _ppermute_edge(tree, axis_name: str, edge: int):
-    """Move edge ``edge``'s payload from stage `edge` to stage `edge`+1
-    (every other device receives zeros — which is what inactive stages
-    should hold)."""
+def _ppermute_edge(tree, axis_name: str, edge: int, reverse: bool = False):
+    """Move edge ``edge``'s payload between stages ``edge`` and ``edge``+1:
+    forward activations stage e → e+1, or (``reverse``) activation
+    cotangents stage e+1 → e. Every other device receives zeros — which is
+    what inactive stages should hold."""
+    perm = [(edge + 1, edge)] if reverse else [(edge, edge + 1)]
     return jax.tree.map(
-        lambda x: jax.lax.ppermute(x, axis_name, perm=[(edge, edge + 1)]), tree
+        lambda x: jax.lax.ppermute(x, axis_name, perm=perm), tree
     )
 
 
@@ -108,54 +160,123 @@ def _zeros_of(template):
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), template)
 
 
-def _build_stage_fns(model, stage_ranges, remat: bool):
-    """One function per stage: chain its segments' (x, skips) → (x, skips)."""
+def _is_stateful(model) -> bool:
+    """Models carrying non-trainable collections (BatchNorm running stats)
+    — one definition with the plain steps (train/steps.py)."""
+    from distributedpytorch_tpu.train.steps import is_stateful_model
 
-    def seg_apply(params, x, skips, seg):
-        return model.apply(
-            {"params": params}, x, skips, seg, method=type(model).apply_segment
-        )
+    return is_stateful_model(model)
+
+
+def _merge_stats(full: dict, updates) -> dict:
+    """Merge a partial ``batch_stats`` update tree (what a mutable apply of
+    ONE segment returns — only that segment's BN layers) into the full
+    collection, preserving the full tree's structure so the result can
+    cross `lax.cond`/carry boundaries against the unmodified tree."""
+    out = dict(full)
+    for k, v in updates.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _merge_stats(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def _build_stage_fns(model, stage_ranges, remat: bool, train: bool = True):
+    """One function per stage: chain its segments' carry → carry.
+
+    Stateless models:  ``stage_fn(params, x, skips) -> (x, skips)``.
+    Stateful models:   ``stage_fn(params, bn, x, skips) -> ((x, skips), bn')``
+    where ``bn`` is the full batch_stats collection and ``bn'`` merges the
+    stage's per-segment updates (train mode; eval applies with the running
+    averages and returns ``bn`` unchanged).
+    """
+    stateful = _is_stateful(model)
+
+    if stateful:
+        def seg_apply(params, bn, x, skips, seg):
+            variables = {"params": params, "batch_stats": bn}
+            if train:
+                (x, skips), upd = model.apply(
+                    variables, x, skips, seg, True,
+                    method=type(model).apply_segment,
+                    mutable=["batch_stats"],
+                )
+                return x, skips, _merge_stats(bn, dict(upd["batch_stats"]))
+            x, skips = model.apply(
+                variables, x, skips, seg, False,
+                method=type(model).apply_segment,
+            )
+            return x, skips, bn
+    else:
+        def seg_apply(params, x, skips, seg):
+            return model.apply(
+                {"params": params}, x, skips, seg,
+                method=type(model).apply_segment,
+            )
 
     fns = []
     for rng in stage_ranges:
-        def stage_fn(params, x, skips, _rng=rng):
-            for seg in _rng:
-                x, skips = seg_apply(params, x, skips, seg)
-            return x, skips
+        if stateful:
+            def stage_fn(params, bn, x, skips, _rng=rng):
+                for seg in _rng:
+                    x, skips, bn = seg_apply(params, bn, x, skips, seg)
+                return (x, skips), bn
+        else:
+            def stage_fn(params, x, skips, _rng=rng):
+                for seg in _rng:
+                    x, skips = seg_apply(params, x, skips, seg)
+                return x, skips
 
         fns.append(jax.checkpoint(stage_fn) if remat else stage_fn)
     return fns
 
 
-def _run_schedule(stage_fns, M, stage_axis, params, first_input, last_fn,
-                  last_zero_fn):
-    """Execute the M+S−1-tick GPipe schedule on this device (inside a
-    shard_map body); returns the last stage's M outputs in microbatch
-    order. ONE definition of the schedule — the loss and forward paths
-    differ only in `last_fn` (VERDICT-r03-era duplication removed).
-
-    ``first_input(m) -> (x, skips)`` feeds stage 0 (a microbatch slice);
-    ``last_fn(params, payload, m) -> array`` is what the final stage does
-    with its stage-input payload; ``last_zero_fn()`` is that output's
-    zeros (what every non-final-stage device holds in each slot — summing
-    or psumming across the stage axis recovers the real values).
-    """
+def _edge_templates(stage_fns, params, bn_state, first_input):
+    """Per-edge payload templates: chain the stage functions over one
+    microbatch's shapes (eval_shape — no FLOPs, no memory). Edge e's
+    template is the carry entering stage e+1."""
     S = len(stage_fns)
-    stage = jax.lax.axis_index(stage_axis)
 
-    # Per-edge payload templates: chain the stage functions over one
-    # microbatch's shapes (eval_shape — no FLOPs, no memory).
     def simulate(params):
         x, skips = first_input(0)
+        bn = bn_state
         outs = []
         for s in range(S - 1):
-            x, skips = stage_fns[s](params, x, skips)
+            if bn_state is not None:
+                (x, skips), bn = stage_fns[s](params, bn, x, skips)
+            else:
+                x, skips = stage_fns[s](params, x, skips)
             outs.append((x, skips))
         return tuple(outs)
 
-    templates = jax.eval_shape(simulate, params)
+    return jax.eval_shape(simulate, params)
+
+
+def _run_schedule(stage_fns, M, stage_axis, params, first_input, last_fn,
+                  last_zero_fn, bn_state=None):
+    """Execute the M+S−1-tick fill-drain forward schedule on this device
+    (inside a shard_map body); returns the last stage's M outputs in
+    microbatch order, paired with the device's final batch_stats when
+    ``bn_state`` is given. ONE definition of the forward schedule — the
+    loss, forward, and 1F1B phase-A paths differ only in `last_fn`.
+
+    ``first_input(m) -> (x, skips)`` feeds stage 0 (a microbatch slice);
+    ``last_fn(params, bn, payload, m) -> (out, bn')`` is what the final
+    stage does with its stage-input payload; ``last_zero_fn()`` is that
+    output's zeros (what every non-final-stage device holds in each slot —
+    summing or psumming across the stage axis recovers the real values).
+    Stateful stages thread the full batch_stats tree tick to tick; each
+    device's tree moves only where its own stage's segments have BN layers.
+    """
+    S = len(stage_fns)
+    stateful = bn_state is not None
+    stage = jax.lax.axis_index(stage_axis)
+
+    templates = _edge_templates(stage_fns, params, bn_state, first_input)
     zero_payloads = [_zeros_of(t) for t in templates]
 
+    bn = bn_state
     outs = []
     in_flight = list(zero_payloads)  # in_flight[e] feeds stage e+1
     for t in range(M + S - 1):
@@ -166,24 +287,73 @@ def _run_schedule(stage_fns, M, stage_axis, params, first_input, last_fn,
                 continue
             payload_in = first_input(m) if s == 0 else in_flight[s - 1]
             if s < S - 1:
-                outgoing[s] = jax.lax.cond(
-                    stage == s,
-                    functools.partial(stage_fns[s], params, *payload_in),
-                    lambda _s=s: zero_payloads[_s],
-                )
+                if stateful:
+                    def work(s=s, payload_in=payload_in, bn=bn):
+                        return stage_fns[s](params, bn, *payload_in)
+
+                    outgoing[s], bn = jax.lax.cond(
+                        stage == s, work,
+                        lambda _s=s, bn=bn: (zero_payloads[_s], bn),
+                    )
+                else:
+                    outgoing[s] = jax.lax.cond(
+                        stage == s,
+                        functools.partial(stage_fns[s], params, *payload_in),
+                        lambda _s=s: zero_payloads[_s],
+                    )
             else:
-                outs.append(jax.lax.cond(
-                    stage == s,
-                    functools.partial(last_fn, params, payload_in, m),
-                    last_zero_fn,
-                ))
+                if stateful:
+                    out, bn = jax.lax.cond(
+                        stage == s,
+                        functools.partial(last_fn, params, bn, payload_in, m),
+                        lambda bn=bn: (last_zero_fn(), bn),
+                    )
+                    outs.append(out)
+                else:
+                    outs.append(jax.lax.cond(
+                        stage == s,
+                        functools.partial(last_fn, params, None, payload_in, m),
+                        last_zero_fn,
+                    ))
         in_flight = [
             _ppermute_edge(outgoing[e], stage_axis, e)
             if outgoing[e] is not None
             else zero_payloads[e]
             for e in range(S - 1)
         ]
-    return outs
+    return outs, bn
+
+
+def _combine_bn(model_state, bn_final, stage_axis, data_axis):
+    """Assemble the replicated post-step batch_stats from per-device final
+    trees: each leaf moved on exactly ONE stage (zeros-delta elsewhere), so
+    psumming the deltas over the stage axis broadcasts every stage's
+    updates to all devices; a hybrid mesh additionally pmeans over 'data'
+    (each replica normalized its own shard — average the running stats)."""
+    def combine(init, fin):
+        delta = jax.lax.psum(fin - init, stage_axis)
+        if data_axis:
+            delta = jax.lax.pmean(delta, data_axis)
+        return init + delta
+
+    return jax.tree.map(combine, model_state, bn_final)
+
+
+def _stats_fn(use_pallas: bool):
+    if use_pallas:
+        from distributedpytorch_tpu.ops.fused_loss import bce_dice_stats_fused
+
+        return bce_dice_stats_fused
+    return bce_dice_stats
+
+
+def _check_microbatching(batch_size: int, M: int) -> int:
+    if batch_size < M or batch_size % M:
+        raise ValueError(
+            f"per-shard batch {batch_size} must be a positive "
+            f"multiple of num_microbatches={M}"
+        )
+    return batch_size // M
 
 
 def make_pipeline_loss_fn(
@@ -196,8 +366,11 @@ def make_pipeline_loss_fn(
     cuts: Optional[Sequence[int]] = None,
     use_pallas: bool = False,
 ) -> Callable:
-    """Build ``loss_fn(params, batch) -> loss`` running the S-stage GPipe
-    schedule over `mesh`'s ``stage`` axis (S = the axis size).
+    """Build the fill-drain (gpipe) pipeline loss over `mesh`'s ``stage``
+    axis (S = the axis size): ``loss_fn(params, batch) -> loss`` for
+    stateless models, ``loss_fn(params, model_state, batch) -> (loss,
+    model_state')`` for stateful (BatchNorm) ones — differentiate the
+    latter with ``has_aux=True``.
 
     `batch` is ``{'image': (B,H,W,3) f32, 'mask': (B,H,W,1) f32 target}``
     with B divisible by num_microbatches (× data-axis size when hybrid).
@@ -212,59 +385,301 @@ def make_pipeline_loss_fn(
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
     stage_fns = _build_stage_fns(model, stage_ranges, remat)
+    stateful = _is_stateful(model)
     M = int(num_microbatches)
     S = num_stages
-    if use_pallas:
-        from distributedpytorch_tpu.ops.fused_loss import bce_dice_stats_fused
-
-        stats_fn = bce_dice_stats_fused
-    else:
-        stats_fn = bce_dice_stats
+    stats_fn = _stats_fn(use_pallas)
 
     batch_spec = P(data_axis) if data_axis else P()
-    in_specs = (P(), {"image": batch_spec, "mask": batch_spec})
-    out_specs = P()
+    axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
+    batch_in_spec = {"image": batch_spec, "mask": batch_spec}
 
-    def per_device(params, batch):
+    def per_device(params, model_state, batch):
         images = batch["image"]
         masks = batch["mask"]
-        if images.shape[0] < M or images.shape[0] % M:
-            raise ValueError(
-                f"per-shard batch {images.shape[0]} must be a positive "
-                f"multiple of num_microbatches={M}"
-            )
-        mb = images.shape[0] // M  # microbatch size (static)
+        mb = _check_microbatching(images.shape[0], M)
 
         def microbatch_input(m):
             return jax.lax.dynamic_slice_in_dim(images, m * mb, mb, axis=0), ()
 
-        def last_stage_stats(params, payload, m):
-            x, _skips = stage_fns[S - 1](params, *payload)
+        def last_stage_stats(params, bn, payload, m):
+            if stateful:
+                (x, _skips), bn = stage_fns[S - 1](params, bn, *payload)
+            else:
+                x, _skips = stage_fns[S - 1](params, *payload)
             target = jax.lax.dynamic_slice_in_dim(masks, m * mb, mb, axis=0)
             # The log-dice term is a ratio of WHOLE-batch sums (reference
             # utils.py:18-23 computes it on the concatenated pipe output), so
             # microbatches accumulate sufficient statistics, not losses.
-            return stats_fn(x, target)
+            out = stats_fn(x, target)
+            return (out, bn) if stateful else out
 
-        per_mb_stats = _run_schedule(
+        per_mb_stats, bn_final = _run_schedule(
             stage_fns, M, stage_axis, params, microbatch_input,
             last_stage_stats, lambda: jnp.zeros((4,), jnp.float32),
+            bn_state=model_state,
         )
         stats_sum = sum(per_mb_stats)
         # Sum stats across the stage axis (only the last stage contributed)
         # and, in the hybrid, across data shards — the result is the EXACT
         # full-global-batch loss, not an average of shard losses.
-        axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
         stats = jax.lax.psum(stats_sum, axes)
-        return loss_from_stats(stats)
+        loss = loss_from_stats(stats)
+        if stateful:
+            return loss, _combine_bn(model_state, bn_final, stage_axis, data_axis)
+        return loss, None
 
-    return shard_map(
-        per_device,
+    if stateful:
+        sharded = shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), P(), batch_in_spec),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+        return sharded
+
+    stateless = shard_map(
+        lambda params, batch: per_device(params, None, batch)[0],
         mesh=mesh,
-        in_specs=in_specs,
-        out_specs=out_specs,
+        in_specs=(P(), batch_in_spec),
+        out_specs=P(),
         check_vma=False,
     )
+    return stateless
+
+
+def make_pipeline_value_and_grad_fn(
+    model,
+    mesh: Mesh,
+    num_microbatches: int = 2,
+    stage_axis: str = "stage",
+    data_axis: str = None,
+    remat: bool = False,
+    cuts: Optional[Sequence[int]] = None,
+    use_pallas: bool = False,
+    schedule: str = "1f1b",
+) -> Callable:
+    """Build ``f(params, model_state, batch) -> (loss, grads, model_state')``
+    for either pipeline schedule (``model_state`` is None for stateless
+    models and passed through unchanged).
+
+    ``schedule='gpipe'`` differentiates the fill-drain loss with
+    `jax.value_and_grad` (activation memory grows with M).
+    ``schedule='1f1b'`` runs the explicit PipeDream-flush schedule built
+    here: phase A is the forward-only stats pass (fill-drain, nothing
+    saved), phase B alternates one-forward-one-backward per stage over
+    2(M+S−1) ticks — forward of microbatch m at stage s on tick s+2m,
+    backward on tick 2S−1−s+2m, so stage s holds at most ≈S−s in-flight
+    input carries and the bubble matches gpipe's. Each backward tick runs
+    `jax.vjp` on the stage's segment run from the saved input carry
+    against the incoming activation cotangent (the global stats cotangent
+    at the last stage); cotangents flow stage s+1 → s over the reverse
+    `ppermute`, and per-stage weight gradients accumulate in float32
+    before one psum over ('stage'[, 'data']) closes DDP_MP. See the
+    module docstring for why the loss's non-additivity forces phase A and
+    why the carried residual is the input carry.
+    """
+    if schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"pipeline schedule must be one of {PIPELINE_SCHEDULES}, "
+            f"got {schedule!r}"
+        )
+    stateful = _is_stateful(model)
+
+    if schedule == "gpipe":
+        loss_fn = make_pipeline_loss_fn(
+            model, mesh, num_microbatches=num_microbatches,
+            stage_axis=stage_axis, data_axis=data_axis, remat=remat,
+            cuts=cuts, use_pallas=use_pallas,
+        )
+        if stateful:
+            def gpipe_vag(params, model_state, batch):
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, model_state, batch)
+                return loss, grads, new_state
+        else:
+            def gpipe_vag(params, model_state, batch):
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                return loss, grads, model_state
+        return gpipe_vag
+
+    num_stages = mesh.shape[stage_axis]
+    stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
+    stage_fns = _build_stage_fns(model, stage_ranges, remat)
+    M = int(num_microbatches)
+    S = num_stages
+    stats_fn = _stats_fn(use_pallas)
+
+    batch_spec = P(data_axis) if data_axis else P()
+    axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
+    batch_in_spec = {"image": batch_spec, "mask": batch_spec}
+
+    def per_device(params, model_state, batch):
+        images = batch["image"]
+        masks = batch["mask"]
+        mb = _check_microbatching(images.shape[0], M)
+        stage = jax.lax.axis_index(stage_axis)
+
+        def microbatch_input(m):
+            return jax.lax.dynamic_slice_in_dim(images, m * mb, mb, axis=0), ()
+
+        def target(m):
+            return jax.lax.dynamic_slice_in_dim(masks, m * mb, mb, axis=0)
+
+        def fwd_stage(s, payload):
+            """Stage forward for phase B (BN in train mode, updates
+            discarded: phase A already accumulated them, and the
+            normalization itself reads only the microbatch moments)."""
+            if stateful:
+                out, _bn = stage_fns[s](params, model_state, *payload)
+                return out
+            return stage_fns[s](params, *payload)
+
+        # ---- phase A: forward-only fill-drain — global loss stats (and
+        # BatchNorm running-stat updates); NOT differentiated, so XLA
+        # frees each tick's activations as soon as the edge payload ships.
+        def last_stage_stats(params, bn, payload, m):
+            if stateful:
+                (x, _skips), bn = stage_fns[S - 1](params, bn, *payload)
+                return stats_fn(x, target(m)), bn
+            x, _skips = stage_fns[S - 1](params, *payload)
+            return stats_fn(x, target(m))
+
+        per_mb_stats, bn_final = _run_schedule(
+            stage_fns, M, stage_axis, params, microbatch_input,
+            last_stage_stats, lambda: jnp.zeros((4,), jnp.float32),
+            bn_state=model_state if stateful else None,
+        )
+        stats = jax.lax.psum(sum(per_mb_stats), axes)
+        loss = loss_from_stats(stats)
+        # the 4-vector every backward needs: ∇loss at the GLOBAL stats
+        ct_stats = jax.grad(loss_from_stats)(stats)
+        new_model_state = (
+            _combine_bn(model_state, bn_final, stage_axis, data_axis)
+            if stateful else model_state
+        )
+
+        # ---- phase B: 1F1B — forward of (s, m) at tick s+2m, backward at
+        # tick 2S−1−s+2m. Forward and backward tick sets of one stage have
+        # opposite parities, so each stage does at most one unit per tick;
+        # the last stage's "forward" tick only banks the arriving carry
+        # (its compute happens inside the backward tick's VJP).
+        templates = _edge_templates(
+            stage_fns, params, model_state if stateful else None,
+            microbatch_input,
+        )
+        zero_payloads = [_zeros_of(t) for t in templates]
+        zero_mb_input = _zeros_of(
+            jax.eval_shape(lambda p: microbatch_input(0), params)
+        )
+        grad_zero = jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32), params
+        )
+        grads = grad_zero
+        saved = {}  # (s, m) -> stage input carry, live ≈S−s ticks
+        fwd_edge = list(zero_payloads)  # fwd_edge[e] feeds stage e+1
+        bwd_edge = list(zero_payloads)  # bwd_edge[e]: cot of stage e's output
+        for t in range(2 * M + 2 * S - 2):
+            out_fwd = [None] * (S - 1)
+            out_bwd = [None] * (S - 1)
+            for s in range(S):
+                m_f, r_f = divmod(t - s, 2)
+                if r_f == 0 and 0 <= m_f < M:  # forward unit
+                    payload_in = (
+                        microbatch_input(m_f) if s == 0 else fwd_edge[s - 1]
+                    )
+                    saved[(s, m_f)] = payload_in
+                    if s < S - 1:
+                        out_fwd[s] = jax.lax.cond(
+                            stage == s,
+                            functools.partial(fwd_stage, s, payload_in),
+                            lambda _s=s: zero_payloads[_s],
+                        )
+                m_b, r_b = divmod(t - (2 * S - 1 - s), 2)
+                if r_b == 0 and 0 <= m_b < M:  # backward unit
+                    payload_in = saved.pop((s, m_b))
+                    ct_in = ct_stats if s == S - 1 else bwd_edge[s]
+
+                    # the f32 grad accumulation lives INSIDE the cond:
+                    # the inactive branch passes the running tree through
+                    # untouched, so only the owning stage's device pays a
+                    # full-param-tree add per backward unit (M adds per
+                    # device per step, not M·S-with-zeros)
+                    def bwd_work(s=s, m=m_b, payload_in=payload_in,
+                                 ct_in=ct_in, grads=grads):
+                        if s == S - 1:
+                            def f(p, payload):
+                                if stateful:
+                                    (y, _sk), _bn = stage_fns[s](
+                                        p, model_state, *payload
+                                    )
+                                else:
+                                    y, _sk = stage_fns[s](p, *payload)
+                                return stats_fn(y, target(m))
+                        else:
+                            def f(p, payload):
+                                if stateful:
+                                    out, _bn = stage_fns[s](
+                                        p, model_state, *payload
+                                    )
+                                    return out
+                                return stage_fns[s](p, *payload)
+
+                        _, vjp = jax.vjp(f, params, payload_in)
+                        g_params, g_payload = vjp(ct_in)
+                        acc = jax.tree.map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            grads, g_params,
+                        )
+                        return acc, g_payload
+
+                    zero_in = zero_mb_input if s == 0 else zero_payloads[s - 1]
+                    grads, g_payload = jax.lax.cond(
+                        stage == s, bwd_work,
+                        lambda g=grads, z=zero_in: (g, z),
+                    )
+                    if s > 0:
+                        out_bwd[s - 1] = g_payload
+            fwd_edge = [
+                _ppermute_edge(out_fwd[e], stage_axis, e)
+                if out_fwd[e] is not None else zero_payloads[e]
+                for e in range(S - 1)
+            ]
+            bwd_edge = [
+                _ppermute_edge(out_bwd[e], stage_axis, e, reverse=True)
+                if out_bwd[e] is not None else zero_payloads[e]
+                for e in range(S - 1)
+            ]
+        # each stage holds only its own segments' gradient leaves (zeros
+        # elsewhere): the stage psum assembles the full gradient; the data
+        # psum is the DDP all-reduce.
+        grads = jax.lax.psum(grads, axes)
+        return loss, grads, new_model_state
+
+    if stateful:
+        return shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), P(), batch_in_spec),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+
+    sharded = shard_map(
+        lambda params, batch: per_device(params, None, batch)[:2],
+        mesh=mesh,
+        in_specs=(P(), batch_in_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    def stateless_vag(params, model_state, batch):
+        loss, grads = sharded(params, batch)
+        return loss, grads, model_state
+
+    return stateless_vag
 
 
 def make_pipeline_forward_fn(
@@ -275,34 +690,48 @@ def make_pipeline_forward_fn(
     data_axis: str = None,
     cuts: Optional[Sequence[int]] = None,
 ) -> Callable:
-    """Pipelined inference: ``forward(params, images) -> preds``.
+    """Pipelined inference: ``forward(variables, images) -> preds``.
 
-    Same schedule as the loss path (literally — `_run_schedule`);
-    predictions are psummed across the stage axis so the output is
-    replicated over 'stage' (the reference's ``.to('cuda:0')`` gather,
-    unet_model.py:53).
+    ``variables`` is the bare params tree for stateless models, or the
+    full ``{'params', 'batch_stats'}`` dict for stateful ones (running
+    averages; nothing mutates). Same fill-drain schedule as the loss path
+    (literally — `_run_schedule`); predictions are psummed across the
+    stage axis so the output is replicated over 'stage' (the reference's
+    ``.to('cuda:0')`` gather, unet_model.py:53).
     """
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
-    stage_fns = _build_stage_fns(model, stage_ranges, remat=False)
+    stateful = _is_stateful(model)
+    stage_fns = _build_stage_fns(model, stage_ranges, remat=False, train=False)
     M = int(num_microbatches)
     S = num_stages
     batch_spec = P(data_axis) if data_axis else P()
 
-    def per_device(params, images):
-        mb = images.shape[0] // M
+    def per_device(variables, images):
+        if stateful:
+            params = variables["params"]
+            bn = variables["batch_stats"]
+        else:
+            params, bn = variables, None
+        # same guard as the train paths: a ragged batch would silently
+        # floor to mb=0 (empty predictions) or drop samples here
+        mb = _check_microbatching(images.shape[0], M)
 
         def microbatch_input(m):
             return jax.lax.dynamic_slice_in_dim(images, m * mb, mb, axis=0), ()
 
-        def last_stage_preds(params, payload, m):
+        def last_stage_preds(params, bn_in, payload, m):
+            if stateful:
+                (x, _skips), bn_in = stage_fns[S - 1](params, bn_in, *payload)
+                return x, bn_in
             x, _skips = stage_fns[S - 1](params, *payload)
             return x
 
         out_shape = (mb,) + images.shape[1:3] + (model.n_classes,)
-        preds = _run_schedule(
+        preds, _ = _run_schedule(
             stage_fns, M, stage_axis, params, microbatch_input,
             last_stage_preds, lambda: jnp.zeros(out_shape, jnp.float32),
+            bn_state=bn,
         )
         out = jnp.concatenate(preds, axis=0)
         # Replicate across the stage axis: the last stage holds the real

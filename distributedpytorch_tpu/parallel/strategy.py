@@ -24,8 +24,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributedpytorch_tpu.config import TrainConfig
 from distributedpytorch_tpu.data.loader import ShardSpec
 from distributedpytorch_tpu.parallel.pipeline import (
+    PIPELINE_SCHEDULES,
     make_pipeline_forward_fn,
-    make_pipeline_loss_fn,
+    make_pipeline_value_and_grad_fn,
 )
 from distributedpytorch_tpu.train.steps import (
     TrainState,
@@ -39,6 +40,18 @@ from distributedpytorch_tpu.train.steps import (
 
 def _prep_mask(mask: jax.Array) -> jax.Array:
     return mask[..., None].astype(jnp.float32)
+
+
+def _validate_pipeline_schedule(config: TrainConfig) -> None:
+    """Fail at strategy CONSTRUCTION (before model build / data setup) on
+    an unknown schedule — one definition for both pipeline strategies
+    (HybridDataPipeline's __init__ bypasses Pipeline's); the pipeline
+    builder itself re-checks for direct API users."""
+    if config.pipeline_schedule not in PIPELINE_SCHEDULES:
+        raise ValueError(
+            f"pipeline_schedule must be one of {PIPELINE_SCHEDULES}, "
+            f"got {config.pipeline_schedule!r}"
+        )
 
 
 def _state_donation(config: Optional[TrainConfig] = None) -> tuple:
@@ -522,14 +535,23 @@ class DistributedDataParallel(MultiProcessMixin, DataParallel):
 
 
 class Pipeline(Strategy):
-    """Reference ``-t MP`` (unet_model.py:14-53): 2-stage microbatched
-    pipeline — encoder+mid on stage 0, decoder+head on stage 1, explicit
-    GPipe schedule over a ('stage',) mesh (see parallel/pipeline.py)."""
+    """Reference ``-t MP`` (unet_model.py:14-53): S-stage microbatched
+    pipeline — encoder+mid on stage 0, decoder+head on stage 1 at the
+    default S=2, explicit schedule over a ('stage',) mesh (see
+    parallel/pipeline.py). ``--pipeline-schedule`` picks the schedule:
+    ``gpipe`` (fill-drain, differentiated through the shard_map — memory
+    grows with the microbatch count) or ``1f1b`` (PipeDream-flush:
+    explicit per-tick vjp backward, in-flight activations bounded by the
+    stage count, so raising --microbatches no longer raises peak HBM).
+    Stateful (BatchNorm) models thread their batch_stats through the
+    stages under either schedule."""
 
     name = "MP"
+    data_axis = None  # the hybrid overrides with "data"
 
     def __init__(self, config: TrainConfig, devices=None):
         super().__init__(config)
+        _validate_pipeline_schedule(config)
         devs = list(devices if devices is not None else jax.local_devices())
         if len(devs) < config.num_stages:
             raise ValueError(
@@ -544,17 +566,6 @@ class Pipeline(Strategy):
     def place_state(self, state):
         return _replicate(self.mesh, state)
 
-    def _loss_fn(self, model):
-        return make_pipeline_loss_fn(
-            model,
-            self.mesh,
-            num_microbatches=self.config.num_microbatches,
-            data_axis=None,
-            remat=self.config.remat,
-            cuts=self.config.pipeline_cuts,
-            use_pallas=self.config.use_pallas,
-        )
-
     def build_accum_train_step(self, model, tx) -> Callable:
         raise ValueError(
             "pipeline strategies already microbatch inside the schedule — "
@@ -562,7 +573,16 @@ class Pipeline(Strategy):
         )
 
     def _raw_step(self, model, tx) -> Callable:
-        pipeline_loss = self._loss_fn(model)
+        pipeline_vag = make_pipeline_value_and_grad_fn(
+            model,
+            self.mesh,
+            num_microbatches=self.config.num_microbatches,
+            data_axis=self.data_axis,
+            remat=self.config.remat,
+            cuts=self.config.pipeline_cuts,
+            use_pallas=self.config.use_pallas,
+            schedule=self.config.pipeline_schedule,
+        )
         # per-process batch, same rationale as Strategy._raw_step
         grad_scale = (
             float(self.config.batch_size)
@@ -572,34 +592,45 @@ class Pipeline(Strategy):
 
         def step(state: TrainState, batch):
             prepped = {"image": batch["image"], "mask": _prep_mask(batch["mask"])}
-            loss, grads = jax.value_and_grad(
-                lambda p: pipeline_loss(p, prepped)
-            )(state.params)
+            loss, grads, model_state = pipeline_vag(
+                state.params, state.model_state, prepped
+            )
             if grad_scale != 1.0:
                 grads = jax.tree.map(lambda g: g * grad_scale, grads)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             return (
-                TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+                TrainState(
+                    params=params,
+                    opt_state=opt_state,
+                    step=state.step + 1,
+                    model_state=model_state,
+                ),
                 loss,
             )
 
         return step
 
-    def build_eval_step(self, model) -> Callable:
-        # Eval runs the pipelined forward too (the reference evaluates
-        # through the pipe model, train.py:62-64 → evaluate.py).
-        self._pallas_eval()  # warn if --pallas was requested: mesh strategy
-        fwd = make_pipeline_forward_fn(
+    def _forward_fn(self, model) -> Callable:
+        return make_pipeline_forward_fn(
             model,
             self.mesh,
             num_microbatches=self.config.num_microbatches,
+            data_axis=self.data_axis,
             cuts=self.config.pipeline_cuts,
         )
+
+    def build_eval_step(self, model) -> Callable:
+        # Eval runs the pipelined forward too (the reference evaluates
+        # through the pipe model, train.py:62-64 → evaluate.py). For
+        # stateful models `variables` is the {'params','batch_stats'} dict
+        # the trainer's _eval_variables() builds (running averages only).
+        self._pallas_eval()  # warn if --pallas was requested: mesh strategy
+        fwd = self._forward_fn(model)
         from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
 
-        def eval_step(params, batch):
-            preds = fwd(params, batch["image"])
+        def eval_step(variables, batch):
+            preds = fwd(variables, batch["image"])
             target = _prep_mask(batch["mask"])
             return {
                 "loss": bce_dice_loss(preds, target),
@@ -613,13 +644,17 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
     """``-t DDP_MP``: data parallel × pipeline on a 2-D ('data','stage')
     mesh — the capability the reference lacks but the driver's north star
     adds (SURVEY.md §2 checklist). Batch sharded over 'data'; each data
-    replica runs the 2-stage schedule over its 'stage' pair; the gradient
-    psum over 'data' is the DDP all-reduce, inserted by autodiff."""
+    replica runs the S-stage schedule (either --pipeline-schedule) over
+    its 'stage' group; the gradient psum over 'data' is the DDP
+    all-reduce — inserted by autodiff under gpipe, issued explicitly by
+    the 1F1B schedule's final grad reduction."""
 
     name = "DDP_MP"
+    data_axis = "data"
 
     def __init__(self, config: TrainConfig, devices=None):
         Strategy.__init__(self, config)
+        _validate_pipeline_schedule(config)
         devs = list(devices if devices is not None else jax.devices())
         stages = config.num_stages
         if len(devs) < 2 * stages:
@@ -656,52 +691,16 @@ class HybridDataPipeline(MultiProcessMixin, Pipeline):
 
     # eval_shard / data_shard: the mixin's row-based assignment —
     # co-row (stage-replica) processes load identical batches; see
-    # MultiProcessMixin._batch_replica_shard.
-
-    def _loss_fn(self, model):
-        return make_pipeline_loss_fn(
-            model,
-            self.mesh,
-            num_microbatches=self.config.num_microbatches,
-            data_axis="data",
-            remat=self.config.remat,
-            cuts=self.config.pipeline_cuts,
-            use_pallas=self.config.use_pallas,
-        )
-
-    def build_eval_step(self, model) -> Callable:
-        self._pallas_eval()  # warn if --pallas was requested: mesh strategy
-        fwd = make_pipeline_forward_fn(
-            model,
-            self.mesh,
-            num_microbatches=self.config.num_microbatches,
-            data_axis="data",
-            cuts=self.config.pipeline_cuts,
-        )
-        from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
-
-        def eval_step(params, batch):
-            preds = fwd(params, batch["image"])
-            target = _prep_mask(batch["mask"])
-            return {
-                "loss": bce_dice_loss(preds, target),
-                "dice": dice_coefficient(preds, target),
-            }
-
-        return jax.jit(eval_step)
+    # MultiProcessMixin._batch_replica_shard. The train step and plain
+    # eval step come from Pipeline (data_axis = "data" routes the batch
+    # sharding and stats/grad psums through the hybrid mesh).
 
     def build_grouped_eval_step(self, model) -> Callable:
         groups = self.eval_shard().world
-        fwd = make_pipeline_forward_fn(
-            model,
-            self.mesh,
-            num_microbatches=self.config.num_microbatches,
-            data_axis="data",
-            cuts=self.config.pipeline_cuts,
-        )
+        fwd = self._forward_fn(model)
 
-        def eval_step(params, batch):
-            preds = fwd(params, batch["image"])
+        def eval_step(variables, batch):
+            preds = fwd(variables, batch["image"])
             return grouped_eval_metrics(preds, _prep_mask(batch["mask"]), groups)
 
         replicated = NamedSharding(self.mesh, P())

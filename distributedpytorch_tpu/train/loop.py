@@ -139,13 +139,11 @@ class Trainer:
         params, model_state = init_fn(
             self.rng, (config.image_size[1], config.image_size[0])
         )
-        if model_state is not None and config.train_method in ("MP", "DDP_MP"):
-            raise ValueError(
-                f"{config.model_arch!r} carries BatchNorm state, which the "
-                "explicit pipeline schedule does not thread across stages "
-                "yet — use a data-parallel/spatial/FSDP strategy, or "
-                "model_arch='unet'"
-            )
+        # BatchNorm state threads through the pipeline schedules
+        # (parallel/pipeline.py): stage functions apply their segments
+        # with mutable batch_stats per microbatch and the stage-axis psum
+        # of the deltas reassembles the replicated running stats — no
+        # BatchNorm-vs-MP guard anymore.
         lr0 = self.strategy.lr_for(config.learning_rate)
         state, self.tx = create_train_state(
             params, lr0, config.weight_decay, model_state=model_state

@@ -103,10 +103,15 @@ def _make_loss_fns(loss_impl):
     return custom_loss_fn, custom_stateful_loss_fn
 
 
-def _is_stateful(model) -> bool:
+def is_stateful_model(model) -> bool:
     """Models that carry non-trainable collections (BatchNorm running
-    stats) declare ``is_stateful = True`` (models/milesial.py)."""
+    stats) declare ``is_stateful = True`` (models/milesial.py). The one
+    definition both the plain steps here and the pipeline schedules
+    (parallel/pipeline.py — stateful stage functions) key off."""
     return bool(getattr(model, "is_stateful", False))
+
+
+_is_stateful = is_stateful_model  # historical internal alias
 
 
 def stateful_loss_fn(

@@ -295,6 +295,32 @@ class TestMainLoop:
         for k in ("BENCH_WGRAD_TAPS", "BENCH_ARCH", "BENCH_BATCH"):
             os.environ.pop(k, None)
 
+    def test_pipeline_sweep_config_dispatches_in_process(self, monkeypatch):
+        """The 300 s 1f1b-vs-gpipe sweep config routes _run_one to
+        tools/bench_pipeline.schedule_sweep (with the config's own budget)
+        instead of bench.run() — the next chip window measures the
+        schedule A/B without a separate launcher."""
+        names = [n for n, _, _ in bench_multi.CONFIGS]
+        _, env, budget = bench_multi.CONFIGS[names.index("pipeline_sched_sweep")]
+        assert budget == 300.0
+        assert env == {"BENCH_PIPELINE_SWEEP": "1"}
+        assert "BENCH_PIPELINE_SWEEP" in bench_multi._CONFIG_ENV_KEYS
+
+        import tools.bench_pipeline as bp
+
+        called = {}
+
+        def fake_sweep(budget_s=0.0):
+            called["budget_s"] = budget_s
+            return {"kind": "pipeline_schedule_sweep"}
+
+        monkeypatch.setattr(bp, "schedule_sweep", fake_sweep)
+        mod = types.SimpleNamespace()  # bench module must never be touched
+        out = bench_multi._run_one(mod, "pipeline_sched_sweep", env, 300.0)
+        assert out == {"kind": "pipeline_schedule_sweep"}
+        assert called["budget_s"] == 300.0
+        assert "BENCH_PIPELINE_SWEEP" not in os.environ  # snapshot restored
+
 
 class TestSupervisorRestarts:
     """Window reports carry the elastic supervisor's restart count, so a

@@ -59,6 +59,9 @@ def test_additive_defaults_are_safe():
     assert args.prefetch_batches == 2
     assert args.max_restarts == 0
     assert args.synthetic == 0
+    # gpipe stays the default until the on-chip schedule A/B lands
+    assert args.pipeline_schedule == "gpipe"
+    assert _parse(["--pipeline-schedule", "1f1b"]).pipeline_schedule == "1f1b"
 
 
 def test_elastic_worker_flags():

@@ -168,6 +168,61 @@ class TestModelEquivalence:
                 np.asarray(b) / scale, np.asarray(a) / scale, atol=5e-5
             )
 
+    def test_level3_cut_loss_and_grads_equal(self):
+        """s2d_levels=3 — the ROADMAP hw-util lever past the default 2:
+        a THIRD encoder/decoder level in the s2d domain adds the cases
+        the 2-level tests never reach (two consecutive s2d encoder levels
+        feeding a third, and the decoder's d2s hand-off chain running
+        twice before the pixel boundary). Same parameters, same loss,
+        same gradients as the pixel path on a 3-level model."""
+        widths = (4, 8, 16)
+        x = jnp.asarray(RNG.random((2, 16, 24, 3)), jnp.float32)
+        base = UNet(dtype=jnp.float32, widths=widths, s2d_levels=0)
+        params = base.init(jax.random.key(5), x)["params"]
+        ref_loss, g0 = self._loss_and_grads(base, params, x)
+        m3 = UNet(dtype=jnp.float32, widths=widths, s2d_levels=3)
+        p3 = m3.init(jax.random.key(5), x)["params"]
+        flat0 = jax.tree_util.tree_leaves_with_path(params)
+        flat3 = jax.tree_util.tree_leaves_with_path(p3)
+        assert [k for k, _ in flat0] == [k for k, _ in flat3]
+        out_loss, g3 = self._loss_and_grads(m3, params, x)
+        np.testing.assert_allclose(float(out_loss), float(ref_loss), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g3)):
+            scale = float(jnp.abs(a).max()) + 1e-8
+            np.testing.assert_allclose(
+                np.asarray(b) / scale, np.asarray(a) / scale, atol=5e-5
+            )
+
+    def test_level3_milesial_forward_matches_pixel(self):
+        """milesial at s2d_levels=3 (its cap is len(widths)−2, so 5
+        widths admit 3): train-mode forward AND updated running stats —
+        _S2DBatchNorm statistics at the third level — equal the pixel
+        path's."""
+        from distributedpytorch_tpu.models.milesial import (
+            MilesialUNet,
+            init_milesial,
+        )
+
+        widths = (2, 4, 8, 16, 32)
+        hw = (16, 32)  # divisible by 2**4
+        m0 = MilesialUNet(widths=widths, dtype=jnp.float32, s2d_levels=0)
+        m3 = MilesialUNet(widths=widths, dtype=jnp.float32, s2d_levels=3)
+        params, stats = init_milesial(m0, jax.random.key(0), input_hw=hw)
+        x = jnp.asarray(RNG.random((2, *hw, 3)), jnp.float32)
+        v = {"params": params, "batch_stats": stats}
+        want, upd0 = m0.apply(v, x, train=True, mutable=["batch_stats"])
+        got, upd3 = m3.apply(v, x, train=True, mutable=["batch_stats"])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-5, atol=5e-6
+        )
+        for a, b in zip(
+            jax.tree.leaves(upd0["batch_stats"]),
+            jax.tree.leaves(upd3["batch_stats"]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=5e-5, atol=5e-6
+            )
+
     def test_full_width_param_golden_with_s2d(self):
         # the 7,760,097-param golden (reference modelsummary.txt:63) holds in
         # s2d mode — the transform declares identical parameters

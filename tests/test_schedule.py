@@ -45,3 +45,41 @@ def test_state_roundtrip():
     s2 = ReduceLROnPlateau(lr=999.0)
     s2.load_state_dict(state)
     assert s2.lr == s.lr and s2.best == s.best and s2.num_bad_epochs == s.num_bad_epochs
+
+
+def test_load_rejects_unknown_keys():
+    s = ReduceLROnPlateau(lr=1e-3)
+    with pytest.raises(ValueError, match="unknown keys.*best_metric"):
+        s.load_state_dict({"lr": 1e-4, "best_metric": 0.5})
+    # the failed load must not have half-applied anything silently
+    assert s.lr == 1e-3
+
+
+def test_load_rederives_legacy_none_best():
+    """A legacy dict restoring best=None must re-run __post_init__ so the
+    sentinel matches the restored mode — stepping afterwards must not
+    TypeError on None comparison and must treat the first metric as an
+    improvement."""
+    s = ReduceLROnPlateau(lr=1e-3)
+    s.load_state_dict({"lr": 5e-4, "best": None, "num_bad_epochs": 1})
+    assert s.best == float("inf")
+    assert s.step(0.7) == 5e-4
+    assert s.best == 0.7 and s.num_bad_epochs == 0
+    smax = ReduceLROnPlateau(lr=1e-3, mode="max")
+    smax.load_state_dict({"best": None})
+    assert smax.best == float("-inf")
+
+
+def test_load_missing_keys_keep_defaults():
+    """Legacy checkpoints may predate newer fields: partial dicts load,
+    untouched fields keep their constructor values."""
+    s = ReduceLROnPlateau(lr=1e-3, patience=5)
+    s.load_state_dict({"lr": 2e-4, "best": 0.3})
+    assert s.lr == 2e-4 and s.best == 0.3 and s.patience == 5
+
+    bad = ReduceLROnPlateau(lr=1e-3)
+    with pytest.raises(ValueError, match="mode"):
+        bad.load_state_dict({"lr": 5e-4, "mode": "minimize"})
+    # a failed load leaves the scheduler fully untouched (no partial
+    # application: lr must not have been set before mode validation)
+    assert bad.lr == 1e-3 and bad.mode == "min"

@@ -234,6 +234,87 @@ class TestPipelineNumerics:
                 pmodel, Mesh(np.array(jax.devices()[:4]), ("stage",)), cuts=None
             )
 
+    def test_1f1b_grads_equal_gpipe(self, pmodel, pparams, pbatch):
+        """The 1F1B schedule (explicit per-tick vjp backward,
+        parallel/pipeline.py) lands on the SAME loss and gradients as the
+        gpipe schedule it replaces — the full (S, M) grid and the memory
+        bound live in tests/test_pipeline_1f1b.py; this is the
+        strategy-suite anchor the ROADMAP names."""
+        from distributedpytorch_tpu.parallel.pipeline import (
+            make_pipeline_value_and_grad_fn,
+        )
+
+        strat = build_strategy(self._pconfig("MP"))
+        prepped = _prep(pbatch)
+        outs = {}
+        for schedule in ("gpipe", "1f1b"):
+            fn = make_pipeline_value_and_grad_fn(
+                pmodel, strat.mesh, num_microbatches=2, schedule=schedule
+            )
+            loss, grads, _ = jax.jit(lambda p, b, _f=fn: _f(p, None, b))(
+                pparams, prepped
+            )
+            outs[schedule] = (float(loss), grads)
+        np.testing.assert_allclose(
+            outs["1f1b"][0], outs["gpipe"][0], rtol=1e-6, atol=1e-7
+        )
+        _tree_allclose(outs["gpipe"][1], outs["1f1b"][1], rtol=2e-4, atol=1e-5)
+
+    def test_milesial_under_mp_grads_match_plain_step(self, devices):
+        """BatchNorm threading through the pipeline (the ROADMAP-listed
+        proof): milesial under MP at one microbatch — where pipeline BN
+        statistics cover exactly the batch the plain step's do — computes
+        the plain single-device stateful step's loss, gradients, and
+        updated running stats. M>1 per-microbatch semantics are pinned in
+        tests/test_pipeline_1f1b.py::TestBatchNormThreading."""
+        from distributedpytorch_tpu.models.milesial import (
+            MilesialUNet,
+            init_milesial,
+        )
+        from distributedpytorch_tpu.parallel.pipeline import (
+            make_pipeline_value_and_grad_fn,
+        )
+
+        model = MilesialUNet(widths=(4, 8), dtype=jnp.float32)
+        params, stats = init_milesial(model, jax.random.key(0), input_hw=(8, 8))
+        rng = np.random.default_rng(5)
+        batch = {
+            "image": jnp.asarray(rng.random((4, 8, 8, 3), dtype=np.float32)),
+            "mask": jnp.asarray(
+                (rng.random((4, 8, 8)) > 0.5).astype(np.float32)
+            )[..., None],
+        }
+
+        def plain(p):
+            preds, upd = model.apply(
+                {"params": p, "batch_stats": stats}, batch["image"],
+                train=True, mutable=["batch_stats"],
+            )
+            return bce_dice_loss(preds, batch["mask"]), upd["batch_stats"]
+
+        (ref_loss, ref_stats), ref_grads = jax.jit(
+            jax.value_and_grad(plain, has_aux=True)
+        )(params)
+
+        cfg = TrainConfig(
+            train_method="MP", batch_size=4, compute_dtype="float32",
+            image_size=(8, 8), model_arch="milesial", model_widths=(4, 8),
+            num_microbatches=1,
+        )
+        strat = build_strategy(cfg)
+        fn = make_pipeline_value_and_grad_fn(
+            model, strat.mesh, num_microbatches=1, schedule="gpipe"
+        )
+        loss, grads, new_stats = jax.jit(fn)(params, stats, batch)
+        np.testing.assert_allclose(
+            float(loss), float(ref_loss), rtol=1e-5, atol=1e-6
+        )
+        _tree_allclose(ref_grads, grads, rtol=2e-4, atol=1e-5)
+        _tree_allclose(
+            jax.device_get(ref_stats), jax.device_get(new_stats),
+            rtol=1e-5, atol=1e-6,
+        )
+
 
 
 class TestStrategySteps:

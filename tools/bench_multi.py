@@ -66,6 +66,13 @@ CONFIGS = [
     ("milesial_pixel",
      {"BENCH_ARCH": "milesial", "BENCH_S2D_LEVELS": "0"}, 1500.0),
     ("pallas_loss", {"BENCH_PALLAS_LOSS": "1"}, 1500.0),
+    # 1F1B vs GPipe microbatch sweep (tools/bench_pipeline.schedule_sweep,
+    # M ∈ {2,4,8,16} at fixed µb size): per-cell temp-buffer bytes from
+    # XLA's buffer assignment + runtime peak_bytes_in_use + imgs/s — the
+    # on-chip side of the activation-wall story. Needs ≥2 devices; on a
+    # single-chip window the sweep records a skip line and exits clean
+    # (no chip time wasted). Cheap, bounded cells → a 300 s budget.
+    ("pipeline_sched_sweep", {"BENCH_PIPELINE_SWEEP": "1"}, 300.0),
     # taps scoped to the top s2d level only (320x480 planes = 153600 px;
     # the next level down is 38400): where the tall-contraction win
     # concentrates, at a severalfold smaller XLA graph than full taps —
@@ -243,6 +250,12 @@ def _run_one(bench, name: str, env: dict, budget: float) -> dict:
         for k in _CONFIG_ENV_KEYS:
             os.environ.pop(k, None)
         os.environ.update(env)
+        if env.get("BENCH_PIPELINE_SWEEP") == "1":
+            # schedule-sweep config: runs bench_pipeline's in-process grid
+            # instead of bench.run()'s single-device step measurement
+            from tools.bench_pipeline import schedule_sweep
+
+            return schedule_sweep(budget_s=budget)
         # run() reads the lever envs itself but takes batch/arch/geometry
         # from module globals frozen at bench import — re-derive them here.
         bench.BATCH = int(env.get("BENCH_BATCH", 4))

@@ -34,6 +34,18 @@ CANNOT observe must be stated up front:
     hardware `tools/tpu_perf_program.sh` is the channel that would close
     the loop.
 
+A fourth leg (round 6) is the SCHEDULE sweep: M ∈ {2,4,8,16} × schedule
+(gpipe vs 1f1b) at FIXED microbatch size (so the batch grows with M —
+the lever 1F1B exists to unlock), recording peak memory alongside
+imgs/s. Peak memory comes from two sources: XLA's buffer assignment
+(`compiled.memory_analysis().temp_size_in_bytes` — available on every
+backend, the traced-liveness ground truth) and the runtime's
+`device.memory_stats()['peak_bytes_in_use']` (TPU only; None on the CPU
+mesh). The expected signature: gpipe temp bytes grow ~linearly in M,
+1f1b's stay bounded by the in-flight count (≈S). The sweep is callable
+in-process (`schedule_sweep()`) so tools/bench_multi.py can run it as a
+300 s chip-window config.
+
 Usage: python tools/bench_pipeline.py [--batch 8] [--hw 64 96]
        [--steps 5] [--json out.jsonl]
 Emits one JSON line per measurement and markdown tables (for
@@ -56,6 +68,121 @@ _PROVISIONED_ENV = "_DPT_BENCH_PIPE_PROVISIONED"
 
 GRID_S = (2, 4)
 GRID_M = (2, 4, 8)
+SWEEP_M = (2, 4, 8, 16)
+# 1f1b first: the runtime's peak_bytes_in_use is a PROCESS-LIFETIME
+# high-water mark with no reset API, so only cells measured before the
+# bigger-footprint schedule runs can read their own true peak — gpipe
+# after 1f1b still reads correctly (it only raises the mark), the other
+# order would stamp gpipe's peak onto every 1f1b cell.
+SWEEP_SCHEDULES = ("1f1b", "gpipe")
+
+
+def schedule_sweep(
+    stages: int = 2,
+    mb_size: int = 2,
+    hw=(32, 48),
+    widths=(8, 16),
+    steps: int = 3,
+    m_grid=SWEEP_M,
+    schedules=SWEEP_SCHEDULES,
+    budget_s: float = 0.0,
+    emit=None,
+) -> dict:
+    """The M × schedule grid at fixed microbatch size.
+
+    Returns a summary dict (also the bench_multi row) and emits one dict
+    per cell through ``emit`` when given. ``budget_s`` > 0 stops opening
+    new cells when the wall budget is near (already-measured cells keep
+    their rows — the chip-window contract bench_multi expects).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedpytorch_tpu.models.unet import UNet
+    from distributedpytorch_tpu.parallel.pipeline import (
+        make_pipeline_value_and_grad_fn,
+    )
+    from jax.sharding import Mesh
+
+    t_start = time.monotonic()
+    devices = jax.devices()
+    if len(devices) < stages:
+        return {
+            "kind": "pipeline_schedule_sweep",
+            "skipped": f"needs >= {stages} devices, have {len(devices)}",
+        }
+    mesh = Mesh(np.array(devices[:stages]), ("stage",))
+    h, w = hw
+    model = UNet(dtype=jnp.float32, s2d_levels=0, widths=tuple(widths))
+    params = model.init(jax.random.key(0), jnp.zeros((1, h, w, 3)))["params"]
+    rng = np.random.default_rng(0)
+    rows, cells = [], []
+    for schedule in schedules:
+        for M in m_grid:
+            if budget_s and time.monotonic() - t_start > 0.7 * budget_s:
+                rows.append({"kind": "pipeline_sweep_cell",
+                             "schedule": schedule, "M": M,
+                             "skipped": "budget"})
+                continue
+            batch_n = M * mb_size
+            batch = {
+                "image": jnp.asarray(
+                    rng.random((batch_n, h, w, 3), dtype=np.float32)),
+                "mask": jnp.asarray(
+                    (rng.random((batch_n, h, w, 1)) > 0.5).astype(np.float32)),
+            }
+            fn = make_pipeline_value_and_grad_fn(
+                model, mesh, num_microbatches=M, schedule=schedule
+            )
+            jit_fn = jax.jit(lambda p, b, _f=fn: _f(p, None, b)[:2])
+            t0 = time.monotonic()
+            compiled = jit_fn.lower(params, batch).compile()
+            compile_s = time.monotonic() - t0
+            ma = compiled.memory_analysis()
+            row = {
+                "kind": "pipeline_sweep_cell",
+                "schedule": schedule, "S": stages, "M": M,
+                "batch": batch_n, "mb_size": mb_size,
+                "compile_s": round(compile_s, 2),
+                "temp_bytes": int(ma.temp_size_in_bytes) if ma else None,
+                "argument_bytes": int(ma.argument_size_in_bytes) if ma else None,
+            }
+            try:
+                jax.block_until_ready(compiled(params, batch))
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = compiled(params, batch)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / steps
+                row["step_ms"] = round(dt * 1e3, 1)
+                row["imgs_per_sec"] = round(batch_n / dt, 1)
+            except Exception as exc:  # OOM / rendezvous starvation
+                row["exec_error"] = f"{type(exc).__name__}: {exc}"
+            stats = devices[0].memory_stats() or {}
+            if stats.get("peak_bytes_in_use") is not None:
+                # process-lifetime high-water mark (see SWEEP_SCHEDULES
+                # note): monotone across cells — a cell's own peak only
+                # when no earlier cell exceeded it; temp_bytes above is
+                # the per-cell ground truth
+                row["device_peak_bytes_cumulative"] = int(
+                    stats["peak_bytes_in_use"])
+            rows.append(row)
+            cells.append(row)
+            if emit is not None:
+                emit(row)
+    by = {(r["schedule"], r["M"]): r for r in cells if "temp_bytes" in r}
+    summary = {
+        "kind": "pipeline_schedule_sweep", "S": stages,
+        "mb_size": mb_size, "hw": list(hw), "rows": rows,
+    }
+    lo, hi = min(m_grid), max(m_grid)
+    for sched in schedules:
+        a, b = by.get((sched, lo)), by.get((sched, hi))
+        if a and b and a.get("temp_bytes") and b.get("temp_bytes"):
+            summary[f"{sched}_temp_growth_m{lo}_to_m{hi}"] = round(
+                b["temp_bytes"] / a["temp_bytes"], 2)
+    return summary
 
 
 def main() -> int:
@@ -206,6 +333,12 @@ def main() -> int:
                           "the (S−1)-tick bubble as a serialized host "
                           "executes it; must grow with S"})
 
+    # ---- leg (d): schedule sweep — M × (gpipe|1f1b) at fixed µb size ----
+    summary = schedule_sweep(
+        stages=2, hw=tuple(args.tiny_hw), steps=args.steps, emit=emit
+    )
+    emit({k: v for k, v in summary.items() if k != "rows"})
+
     # ---- markdown tables for docs/DISTRIBUTED.md ----
     print("\n| S | M | ticks | bubble | efficiency | HLO permutes "
           "(≥ M·(S−1)) | predicted parallel step ms | predicted speedup "
@@ -229,6 +362,15 @@ def main() -> int:
             continue
         print(f"| {r['S']} | {r['per_microbatch_ms']} "
               f"| {r['intercept_ms']} |")
+    print("\n| schedule | M | batch | temp bytes (XLA buffer assignment) "
+          "| step ms | imgs/s |")
+    print("|---|---|---|---|---|---|")
+    for r in records:
+        if r["kind"] != "pipeline_sweep_cell" or r.get("skipped"):
+            continue
+        print(f"| {r['schedule']} | {r['M']} | {r['batch']} "
+              f"| {r.get('temp_bytes')} | {r.get('step_ms', '—')} "
+              f"| {r.get('imgs_per_sec', '—')} |")
     return 0
 
 
