@@ -5,7 +5,14 @@ supervisor subcommand:
     python -m distributedpytorch_tpu elastic -n 2 -- -t FSDP ...
 
 which spawns/supervises the worker ranks (dist/elastic.py) the way the
-reference's ``torchrun`` launcher does (README.md:37)."""
+reference's ``torchrun`` launcher does (README.md:37), and the static
+analyzer:
+
+    python -m distributedpytorch_tpu analyze [--strategies ...]
+
+which runs dptlint (analysis/: jaxpr collective checker + SPMD source
+lint; docs/ANALYSIS.md) on a self-provisioned CPU mesh — the CI
+``lint-distributed`` gate and the bench/elastic preflights call this."""
 
 import sys
 
@@ -15,6 +22,10 @@ def main() -> None:
         from distributedpytorch_tpu.dist.elastic import main as elastic_main
 
         sys.exit(elastic_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "analyze":
+        from distributedpytorch_tpu.analysis.cli import main as analyze_main
+
+        sys.exit(analyze_main(sys.argv[2:]))
     from distributedpytorch_tpu.cli import main as cli_main
 
     cli_main()

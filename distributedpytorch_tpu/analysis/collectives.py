@@ -1,0 +1,712 @@
+"""Layer 1: the jaxpr collective checker.
+
+Abstractly traces each strategy's train/eval step on the CPU mesh —
+``jax.make_jaxpr`` over ``ShapeDtypeStruct`` inputs, so NO device ever
+executes anything — then walks the closed jaxpr (descending into
+``shard_map``/``pjit``/``scan``/``cond``/``remat`` subjaxprs) to extract
+the ordered collective program: every ``psum`` / ``all_gather`` /
+``reduce_scatter`` / ``ppermute`` with its axis names and permutation.
+Four checks over that program:
+
+(a) **axis binding** — every collective's axis name is bound by the
+    enclosing ``shard_map`` mesh; an unbound axis would fail at run time
+    (or worse, under ``check_vma=False``, silently misresolve).
+
+(b) **ppermute bijectivity + tick-program deadlock-freedom** — each
+    permutation must be a partial bijection (no duplicated sources or
+    destinations), and the composed tick program must be deadlock-free.
+    Deadlock-freedom is checked by simulating the send/recv schedule per
+    stage: the stage that PRODUCES a payload (the ``stage == s`` branch
+    of the ``lax.cond`` feeding the ppermute) must appear among the
+    permutation's sources, and every stage that CONSUMES the ppermuted
+    value (the ``stage == j`` cond it feeds) must appear among the
+    destinations. A flipped edge in the 1F1B phase-B program — perm
+    ``((e, e+1),)`` where the cotangent producer is stage ``e+1`` —
+    leaves stage ``e+1``'s send unposted and stage ``e`` waiting on a
+    payload that never arrives: exactly the cyclic wait that hangs the
+    CPU rendezvous for 300 s in CI, failed here statically instead.
+    Producer/consumer attribution resolves cond predicates of the form
+    ``eq(axis_index('stage'), <literal>)``; ppermutes whose endpoints
+    don't resolve (e.g. autodiff-transposed gpipe programs) pass through
+    unflagged — the check is sound, not complete.
+
+(c) **SPMD rank uniformity** — (i) no collective may sit inside a
+    ``cond`` branch whose predicate depends on ``axis_index`` (devices
+    along the axis would execute divergent collective sequences); and
+    (ii) the step is re-traced under simulated process identities
+    (``jax.process_index`` patched to 0 and then 1) and the two
+    extracted collective programs must be identical — a ``psum`` guarded
+    by an ``if jax.process_index() == 0:`` Python conditional traces
+    into rank 0's program only and is flagged here, instead of hanging a
+    real 2-process run.
+
+(d) **comms contract** — each strategy's extracted program must satisfy
+    its declared contract below. ``EXPECTED_HLO_COLLECTIVES`` (the table
+    ``tests/test_hlo_collectives.py`` used to hardcode, now owned here
+    and imported by that test) describes the post-GSPMD optimized-HLO
+    collectives; ``JAXPR_CONTRACTS`` describes the trace-level program of
+    the explicit shard_map schedules, including the schedule-closing
+    gradient psum whose 'data' axis IS the DDP all-reduce for DDP_MP —
+    dropping it would silently fork the data replicas.
+
+The GSPMD strategies (DP/SP/TP/FSDP) have EMPTY jaxpr-level programs
+(XLA inserts their collectives at compile time); their contract lives in
+the HLO tier, verified by ``hlo_collectives`` under ``--hlo`` (an AOT
+CPU compile — still zero execution) and independently cross-checked by
+tests/test_hlo_collectives.py's regex in tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import unittest.mock
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from distributedpytorch_tpu.analysis import (
+    ANALYSIS_SCHEDULES,
+    ANALYSIS_STRATEGIES,
+    AnalysisEnvironmentError,
+    Finding,
+    dedupe,
+)
+
+# -- the tiny analysis rig ---------------------------------------------------
+# Same shapes as tests/test_strategies.py's equivalence rig: the analyzer
+# exercises the parallelism machinery, where the model is a payload — the
+# collective program of the 2-level narrow UNet is structurally identical
+# to the reference-sized model's, and traces in ~2 s per combo.
+H, W, B = 32, 48, 8
+WIDTHS = (8, 16)
+
+#: ANALYSIS_STRATEGIES / ANALYSIS_SCHEDULES live in the jax-free package
+#: ``__init__`` (preflight call sites gate on them) and are re-exported
+#: here as the checker's defaults.
+PIPELINE_STRATEGIES = ("MP", "DDP_MP")
+
+#: Collective primitives extracted from jaxprs.
+COLLECTIVE_PRIMS = frozenset(
+    {"psum", "ppermute", "all_gather", "reduce_scatter", "all_to_all",
+     "pmin", "pmax"}
+)
+
+# -- the declared comms contract (check d) -----------------------------------
+#: Optimized-HLO collectives each strategy's compiled train step must
+#: contain (verified against XLA's output on the 8-device CPU mesh).
+#: This is the single source tests/test_hlo_collectives.py imports; the
+#: test keeps its own independent regex over compiled.as_text().
+EXPECTED_HLO_COLLECTIVES: Dict[str, FrozenSet[str]] = {
+    "DP": frozenset({"all-reduce"}),            # gradient reduction
+    "SP": frozenset({"collective-permute"}),    # conv halo exchanges
+    "FSDP": frozenset({"all-gather"}),          # ZeRO param gathering
+    "MP": frozenset({"collective-permute"}),    # ppermute stage transfers
+    "DDP_MP": frozenset({"collective-permute", "all-reduce"}),
+}
+#: TP's sharded-channel layers must communicate somehow; XLA picks the
+#: mechanism per version — any of these proves channels are distributed.
+TP_HLO_ANY_OF = frozenset({"all-to-all", "all-gather", "collective-permute"})
+
+
+@dataclasses.dataclass(frozen=True)
+class JaxprComm:
+    """One trace-level contract requirement: a collective of ``kind``
+    whose axes cover ``axes`` must exist; ``grad_output=True`` restricts
+    candidates to collectives whose results ARE step outputs (the
+    schedule-closing gradient reduction), so a stats psum that happens to
+    share the axes cannot mask a dropped grad psum."""
+
+    kind: str
+    axes: FrozenSet[str]
+    grad_output: bool = False
+    why: str = ""
+
+
+#: Trace-level contract per (strategy, schedule). GSPMD strategies have
+#: no jaxpr-visible collectives — their row is empty and their contract
+#: lives in EXPECTED_HLO_COLLECTIVES.
+JAXPR_CONTRACTS: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = {
+    ("DP", None): (),
+    ("SP", None): (),
+    ("TP", None): (),
+    ("FSDP", None): (),
+    ("MP", "gpipe"): (
+        JaxprComm("ppermute", frozenset({"stage"}),
+                  why="inter-stage activation transfers"),
+        JaxprComm("psum", frozenset({"stage"}),
+                  why="whole-batch loss-stats reduction"),
+    ),
+    ("MP", "1f1b"): (
+        JaxprComm("ppermute", frozenset({"stage"}),
+                  why="inter-stage activation/cotangent transfers"),
+        JaxprComm("psum", frozenset({"stage"}),
+                  why="whole-batch loss-stats reduction"),
+        JaxprComm("psum", frozenset({"stage"}), grad_output=True,
+                  why="schedule-closing gradient assembly across stages"),
+    ),
+    ("DDP_MP", "gpipe"): (
+        JaxprComm("ppermute", frozenset({"stage"}),
+                  why="inter-stage activation transfers"),
+        JaxprComm("psum", frozenset({"stage", "data"}),
+                  why="whole-batch loss-stats reduction across stages "
+                      "AND data shards"),
+    ),
+    ("DDP_MP", "1f1b"): (
+        JaxprComm("ppermute", frozenset({"stage"}),
+                  why="inter-stage activation/cotangent transfers"),
+        JaxprComm("psum", frozenset({"stage", "data"}),
+                  why="whole-batch loss-stats reduction"),
+        JaxprComm("psum", frozenset({"stage", "data"}), grad_output=True,
+                  why="schedule-closing gradient psum — the 'data' axis "
+                      "IS the DDP all-reduce"),
+    ),
+}
+
+
+# -- extraction --------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class Collective:
+    """One extracted collective with everything the checks need."""
+
+    kind: str
+    axes: Tuple[object, ...]          # axis names (strs; ints under vmap)
+    perm: Optional[Tuple[Tuple[int, int], ...]]
+    context: Tuple[str, ...]          # enclosing-eqn path, e.g. (pjit, shard_map)
+    bound_axes: FrozenSet[str]        # mesh axes in scope at this point
+    producer_stage: Optional[int]     # stage whose cond branch made the input
+    consumer_stages: Tuple[int, ...]  # stages whose conds consume the output
+    direct_output: bool               # results are body outputs (grad psum)
+    axis_guarded: bool                # inside an axis_index-dependent branch
+
+    @property
+    def signature(self) -> Tuple:
+        """Order-sensitive identity for rank-invariance comparison."""
+        return (self.kind, self.axes, self.perm, self.context)
+
+
+def _subjaxprs(value) -> List:
+    """Jaxpr objects reachable from one eqn param value (ClosedJaxpr,
+    Jaxpr, or tuples of either — cond branches, scan bodies, ...)."""
+    # ClosedJaxpr proxies .eqns, so unwrap .jaxpr FIRST (the walker needs
+    # the raw Jaxpr's outvars for the direct-output attribution)
+    if hasattr(value, "jaxpr") and hasattr(value.jaxpr, "eqns"):
+        return [value.jaxpr]
+    if hasattr(value, "eqns"):
+        return [value]
+    if isinstance(value, (tuple, list)):
+        out = []
+        for v in value:
+            out.extend(_subjaxprs(v))
+        return out
+    return []
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def _body_attribution(jaxpr):
+    """Per-body maps for producer/consumer attribution and axis-guard
+    detection: which vars come from ``cond(eq(axis_index(ax), s), ...)``
+    branches, which conds consume which vars, and which cond predicates
+    depend on ``axis_index`` at all."""
+    producer_eqn: Dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for ov in eqn.outvars:
+            producer_eqn[ov] = i
+    axis_vars = {
+        e.outvars[0]: e.params["axis_name"]
+        for e in jaxpr.eqns
+        if e.primitive.name == "axis_index"
+    }
+
+    def resolve_stage(var, depth=0):
+        """cond index var -> (axis, stage) when the predicate is
+        ``eq(axis_index(axis), literal)`` (possibly through dtype
+        conversions)."""
+        if _is_literal(var) or var not in producer_eqn or depth > 6:
+            return None
+        eqn = jaxpr.eqns[producer_eqn[var]]
+        name = eqn.primitive.name
+        if name == "convert_element_type":
+            return resolve_stage(eqn.invars[0], depth + 1)
+        if name == "eq":
+            a, b = eqn.invars
+            for x, y in ((a, b), (b, a)):
+                if (not _is_literal(x) and x in axis_vars
+                        and _is_literal(y)):
+                    return (axis_vars[x], int(y.val))
+        return None
+
+    def depends_on_axis(var, depth=0):
+        """Does this var transitively derive from an axis_index?"""
+        if _is_literal(var) or var not in producer_eqn or depth > 8:
+            return False
+        if var in axis_vars:
+            return True
+        eqn = jaxpr.eqns[producer_eqn[var]]
+        return any(
+            depends_on_axis(iv, depth + 1)
+            for iv in eqn.invars
+            if not _is_literal(iv)
+        )
+
+    cond_stage: Dict[int, Tuple[str, int]] = {}
+    cond_axis_dep: Dict[int, bool] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive.name != "cond":
+            continue
+        idx = eqn.invars[0]
+        resolved = resolve_stage(idx)
+        if resolved is not None:
+            cond_stage[i] = resolved
+            cond_axis_dep[i] = True
+        else:
+            cond_axis_dep[i] = (
+                False if _is_literal(idx) else depends_on_axis(idx)
+            )
+
+    outvar_stage: Dict = {}
+    for i, (_ax, stage) in cond_stage.items():
+        for ov in jaxpr.eqns[i].outvars:
+            outvar_stage[ov] = stage
+    consumers: Dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        if i in cond_stage:
+            for iv in eqn.invars:
+                if not _is_literal(iv):
+                    consumers.setdefault(iv, []).append(cond_stage[i][1])
+    return producer_eqn, outvar_stage, consumers, cond_axis_dep
+
+
+def extract_collectives(closed_jaxpr) -> List[Collective]:
+    """Walk a ClosedJaxpr (and every reachable subjaxpr) and return its
+    ordered collective program."""
+    out: List[Collective] = []
+
+    def walk(jaxpr, context, bound_axes, guarded):
+        _prod, outvar_stage, consumers, cond_axis_dep = _body_attribution(
+            jaxpr
+        )
+        body_outs = {
+            v for v in jaxpr.outvars if not _is_literal(v)
+        }
+        for i, eqn in enumerate(jaxpr.eqns):
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                params = eqn.params
+                raw_axes = params.get("axes", params.get("axis_name", ()))
+                if not isinstance(raw_axes, (tuple, list)):
+                    raw_axes = (raw_axes,)
+                perm = params.get("perm")
+                if perm is not None:
+                    perm = tuple((int(a), int(b)) for a, b in perm)
+                out.append(
+                    Collective(
+                        kind=name,
+                        axes=tuple(raw_axes),
+                        perm=perm,
+                        context=context,
+                        bound_axes=bound_axes,
+                        producer_stage=outvar_stage.get(eqn.invars[0])
+                        if eqn.invars else None,
+                        consumer_stages=tuple(
+                            consumers.get(eqn.outvars[0], ())
+                        ) if eqn.outvars else (),
+                        direct_output=any(
+                            ov in body_outs for ov in eqn.outvars
+                        ),
+                        axis_guarded=guarded,
+                    )
+                )
+                continue
+            sub_bound = bound_axes
+            if name == "shard_map":
+                mesh = eqn.params.get("mesh")
+                axis_names = tuple(getattr(mesh, "axis_names", ()) or ())
+                sub_bound = bound_axes | frozenset(
+                    a for a in axis_names if isinstance(a, str)
+                )
+            sub_guarded = guarded or (
+                name == "cond" and cond_axis_dep.get(i, False)
+            )
+            for key, value in eqn.params.items():
+                for sub in _subjaxprs(value):
+                    walk(sub, context + (name,), sub_bound, sub_guarded)
+
+    walk(closed_jaxpr.jaxpr, (), frozenset(), False)
+    return out
+
+
+# -- abstract tracing --------------------------------------------------------
+def _require_devices(n: int) -> None:
+    import jax
+
+    have = len(jax.devices())
+    if have < n:
+        raise AnalysisEnvironmentError(
+            f"the analyzer needs >= {n} devices (an 8-device virtual CPU "
+            f"mesh; the analyze CLI self-provisions one), got {have}"
+        )
+
+
+def _tiny_config(method: str, schedule: Optional[str]):
+    from distributedpytorch_tpu.config import TrainConfig
+
+    return TrainConfig(
+        train_method=method,
+        batch_size=B,
+        compute_dtype="float32",
+        image_size=(W, H),
+        model_widths=WIDTHS,
+        pipeline_schedule=schedule or "gpipe",
+    )
+
+
+def _build(method: str, schedule: Optional[str]):
+    """(strategy, model, abstract_state, tx, abstract_batch) for one
+    combo — everything ShapeDtypeStructs; nothing placed, nothing run."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributedpytorch_tpu.models.unet import UNet
+    from distributedpytorch_tpu.ops.optim import adam_l2
+    from distributedpytorch_tpu.parallel import build_strategy
+    from distributedpytorch_tpu.train.steps import TrainState
+
+    _require_devices(8 if method in ("DDP_MP", "DDP_SP") else 2)
+    cfg = _tiny_config(method, schedule)
+    strategy = build_strategy(cfg)
+    model = UNet(dtype=jnp.float32, widths=WIDTHS)
+    params = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, H, W, 3)))["params"],
+        jax.random.key(0),
+    )
+    tx = adam_l2(cfg.learning_rate, cfg.weight_decay)
+    opt_state = jax.eval_shape(tx.init, params)
+    state = TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        model_state=None,
+    )
+    batch = {
+        "image": jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32),
+        "mask": jax.ShapeDtypeStruct((B, H, W), jnp.int32),
+    }
+    return strategy, model, state, tx, batch
+
+
+def trace_train(method: str, schedule: Optional[str] = None):
+    """The strategy's (unjitted) train step as a ClosedJaxpr — a fresh
+    build per call, so repeated traces (the simulated-rank check) never
+    reuse a cached jaxpr from a previous identity."""
+    import jax
+
+    strategy, model, state, tx, batch = _build(method, schedule)
+    step = strategy._raw_step(model, tx)
+    return jax.make_jaxpr(step)(state, batch)
+
+
+def trace_eval(method: str, schedule: Optional[str] = None):
+    """The strategy's jitted eval step as a ClosedJaxpr."""
+    import jax
+
+    strategy, model, state, _tx, batch = _build(method, schedule)
+    eval_step = strategy.build_eval_step(model)
+    return jax.make_jaxpr(eval_step)(state.params, batch)
+
+
+# -- checks ------------------------------------------------------------------
+def _combo_tag(method: str, schedule: Optional[str], kind: str) -> str:
+    sched = f"/{schedule}" if schedule else ""
+    return f"{method}{sched} {kind} step"
+
+
+def check_axis_binding(colls, where: str) -> List[Finding]:
+    findings = []
+    for c in colls:
+        unbound = [
+            a for a in c.axes if isinstance(a, str) and a not in c.bound_axes
+        ]
+        if unbound:
+            findings.append(Finding(
+                rule="unbound-axis",
+                where=where,
+                message=(
+                    f"{c.kind} names axis {unbound} but the enclosing mesh "
+                    f"binds only {sorted(c.bound_axes)} — the collective "
+                    f"cannot resolve at run time"
+                ),
+                layer="collectives",
+            ))
+    return findings
+
+
+def check_ppermute_flow(colls, where: str) -> List[Finding]:
+    """Bijectivity plus the send/recv simulation (docstring check b)."""
+    findings = []
+    for c in colls:
+        if c.kind != "ppermute" or c.perm is None:
+            continue
+        srcs = [a for a, _ in c.perm]
+        dsts = [b for _, b in c.perm]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            findings.append(Finding(
+                rule="ppermute-bijection",
+                where=where,
+                message=(
+                    f"ppermute perm {c.perm} is not a partial bijection "
+                    f"(duplicate source or destination) — results are "
+                    f"undefined"
+                ),
+                layer="collectives",
+            ))
+            continue
+        src_set, dst_set = set(srcs), set(dsts)
+        if c.producer_stage is not None and c.producer_stage not in src_set:
+            findings.append(Finding(
+                rule="ppermute-deadlock",
+                where=where,
+                message=(
+                    f"tick-program deadlock: the payload is produced under "
+                    f"the stage=={c.producer_stage} branch but ppermute "
+                    f"perm {c.perm} never sends from stage "
+                    f"{c.producer_stage} — its send is unposted and the "
+                    f"receiving stage waits forever (flipped edge? the "
+                    f"dynamic symptom is the 300 s CPU-rendezvous hang)"
+                ),
+                layer="collectives",
+            ))
+        for j in c.consumer_stages:
+            if j not in dst_set:
+                findings.append(Finding(
+                    rule="ppermute-deadlock",
+                    where=where,
+                    message=(
+                        f"tick-program deadlock: stage {j} consumes this "
+                        f"ppermute's output but perm {c.perm} never "
+                        f"delivers to stage {j} — unmatched recv; stage "
+                        f"{j} would block on a payload that never arrives"
+                    ),
+                    layer="collectives",
+                ))
+    return findings
+
+
+def check_uniform_branches(colls, where: str) -> List[Finding]:
+    findings = []
+    for c in colls:
+        if c.axis_guarded:
+            findings.append(Finding(
+                rule="branch-divergent-collective",
+                where=where,
+                message=(
+                    f"{c.kind} over {c.axes} sits inside a cond branch "
+                    f"whose predicate depends on axis_index — devices "
+                    f"along the axis would execute divergent collective "
+                    f"sequences (rendezvous deadlock); hoist the "
+                    f"collective out of the branch"
+                ),
+                layer="collectives",
+            ))
+    return findings
+
+
+def check_contract(method: str, schedule: Optional[str], colls,
+                   where: str) -> List[Finding]:
+    key = (method, schedule if method in PIPELINE_STRATEGIES else None)
+    findings = []
+    for req in JAXPR_CONTRACTS.get(key, ()):
+        candidates = [
+            c for c in colls
+            if c.kind == req.kind
+            and (not req.grad_output or c.direct_output)
+            and req.axes <= {a for a in c.axes if isinstance(a, str)}
+        ]
+        if not candidates:
+            what = ("schedule-closing (output-feeding) " if req.grad_output
+                    else "")
+            findings.append(Finding(
+                rule="comms-contract",
+                where=where,
+                message=(
+                    f"declared contract violated: no {what}{req.kind} over "
+                    f"axes covering {sorted(req.axes)} in the traced "
+                    f"program ({req.why}) — "
+                    + (
+                        "a missing 'data' reduction silently forks the "
+                        "data replicas"
+                        if "data" in req.axes else
+                        "the strategy degenerated from its declared "
+                        "communication pattern"
+                    )
+                ),
+                layer="collectives",
+            ))
+    return findings
+
+
+def check_rank_invariance(method: str, schedule: Optional[str],
+                          base_signatures) -> List[Finding]:
+    """Re-trace the train step with ``jax.process_index`` patched to 1
+    and diff the collective program against the rank-0 trace
+    (``base_signatures``). Any difference means a Python-level
+    rank-dependent branch reached a collective: the program is not
+    provably SPMD-uniform."""
+    import jax
+
+    with unittest.mock.patch.object(jax, "process_index", lambda: 1):
+        other = [c.signature for c in extract_collectives(
+            trace_train(method, schedule))]
+    if list(base_signatures) == other:
+        return []
+    n0, n1 = len(base_signatures), len(other)
+    diff_at = next(
+        (i for i, (a, b) in enumerate(zip(base_signatures, other)) if a != b),
+        min(n0, n1),
+    )
+    return [Finding(
+        rule="rank-divergent-collective",
+        where=_combo_tag(method, schedule, "train"),
+        message=(
+            f"collective program differs between simulated ranks (rank 0: "
+            f"{n0} collectives, rank 1: {n1}; first divergence at program "
+            f"position {diff_at}) — a collective is guarded by a "
+            f"process_index()/rank Python conditional, so real ranks would "
+            f"trace different programs and deadlock at the first unmatched "
+            f"collective; make the collective sequence rank-invariant"
+        ),
+        layer="collectives",
+    )]
+
+
+# -- HLO tier (opt-in: AOT compile, still zero execution) --------------------
+_HLO_COLLECTIVE_NAMES = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+)
+
+
+def hlo_collectives(method: str, schedule: Optional[str] = None) -> set:
+    """Collective op names in the optimized HLO of the strategy's
+    compiled train step. Ahead-of-time: inputs are ShapeDtypeStructs
+    carrying the strategy's shardings, so the GSPMD partitioner runs but
+    nothing executes and no device memory is committed."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    strategy, model, state, tx, batch = _build(method, schedule)
+    mesh = strategy.mesh
+    if mesh is None:
+        return set()
+
+    leaf_spec = getattr(strategy, "_leaf_spec", lambda shape: P())
+
+    def with_sharding(leaf, spec):
+        return jax.ShapeDtypeStruct(
+            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+        )
+
+    state = jax.tree.map(lambda x: with_sharding(x, leaf_spec(x.shape)), state)
+    batch = {
+        k: with_sharding(v, strategy.batch_sharding.spec)
+        for k, v in batch.items()
+    }
+    compiled = strategy.build_train_step(model, tx).lower(
+        state, batch).compile()
+    text = compiled.as_text()
+    return {name for name in _HLO_COLLECTIVE_NAMES if name in text}
+
+
+def check_hlo_contract(method: str, schedule: Optional[str]) -> List[Finding]:
+    where = _combo_tag(method, schedule, "compiled train")
+    ops = hlo_collectives(method, schedule)
+    if method == "TP":
+        if not (ops & TP_HLO_ANY_OF):
+            return [Finding(
+                rule="comms-contract-hlo",
+                where=where,
+                message=(
+                    f"optimized HLO contains none of "
+                    f"{sorted(TP_HLO_ANY_OF)} — TP's sharded channels are "
+                    f"not actually communicating (degenerated to "
+                    f"replication?); found {sorted(ops)}"
+                ),
+                layer="collectives",
+            )]
+        return []
+    required = EXPECTED_HLO_COLLECTIVES.get(method)
+    if required is None or required <= ops:
+        return []
+    return [Finding(
+        rule="comms-contract-hlo",
+        where=where,
+        message=(
+            f"optimized HLO is missing {sorted(required - ops)} (found "
+            f"{sorted(ops)}) — the strategy silently degenerated: its "
+            f"parallelism implies that communication"
+        ),
+        layer="collectives",
+    )]
+
+
+# -- drivers -----------------------------------------------------------------
+def combos_for(strategies: Sequence[str] = ANALYSIS_STRATEGIES,
+               schedules: Sequence[str] = ANALYSIS_SCHEDULES
+               ) -> List[Tuple[str, Optional[str]]]:
+    combos: List[Tuple[str, Optional[str]]] = []
+    for method in strategies:
+        if method in PIPELINE_STRATEGIES:
+            combos.extend((method, s) for s in schedules)
+        else:
+            combos.append((method, None))
+    return combos
+
+
+def analyze_combo(method: str, schedule: Optional[str] = None,
+                  hlo: bool = False, rank_check: bool = True
+                  ) -> List[Finding]:
+    """Run every layer-1 check for one strategy × schedule combo.
+    Trace-only unless ``hlo``; zero device execution either way."""
+    if method in PIPELINE_STRATEGIES and schedule is None:
+        # the trace rig defaults a missing schedule to gpipe; the
+        # contract key must name the program actually traced, or the
+        # ('MP', None) lookup misses JAXPR_CONTRACTS and the
+        # comms-contract check silently becomes vacuous
+        schedule = "gpipe"
+    findings: List[Finding] = []
+
+    train_jaxpr = trace_train(method, schedule)
+    train_colls = extract_collectives(train_jaxpr)
+    where = _combo_tag(method, schedule, "train")
+    findings += check_axis_binding(train_colls, where)
+    findings += check_ppermute_flow(train_colls, where)
+    findings += check_uniform_branches(train_colls, where)
+    findings += check_contract(method, schedule, train_colls, where)
+
+    eval_colls = extract_collectives(trace_eval(method, schedule))
+    where_e = _combo_tag(method, schedule, "eval")
+    findings += check_axis_binding(eval_colls, where_e)
+    findings += check_ppermute_flow(eval_colls, where_e)
+    findings += check_uniform_branches(eval_colls, where_e)
+
+    if rank_check:
+        findings += check_rank_invariance(
+            method, schedule, [c.signature for c in train_colls]
+        )
+    if hlo:
+        findings += check_hlo_contract(method, schedule)
+    return dedupe(findings)
+
+
+def analyze(strategies: Sequence[str] = ANALYSIS_STRATEGIES,
+            schedules: Sequence[str] = ANALYSIS_SCHEDULES,
+            hlo: bool = False, rank_check: bool = True):
+    """Analyze every requested combo; returns ``(findings, combo_tags)``."""
+    findings: List[Finding] = []
+    tags = []
+    for method, schedule in combos_for(strategies, schedules):
+        tags.append(f"{method}/{schedule}" if schedule else method)
+        findings += analyze_combo(
+            method, schedule, hlo=hlo, rank_check=rank_check
+        )
+    return findings, tags

@@ -1,0 +1,505 @@
+"""Layer 2: project-specific AST lint over the package source.
+
+Pure ``ast`` — no jax import, so jax-free processes (the elastic
+supervisor) and cold CI jobs can run it in milliseconds. Rules (catalog
+with rationale and what each provably excludes: docs/ANALYSIS.md):
+
+* ``trace-nondeterminism`` — ``time.time``/``random.*``/``np.random.*``
+  (and friends) inside functions that end up traced by jax. A traced
+  call executes ONCE at trace time and freezes its value into the
+  compiled program: what looks like per-step randomness is a constant,
+  and what looks like a timestamp is the compile time. Traced functions
+  are detected as: arguments to jit/shard_map/grad/cond/scan/... calls,
+  functions decorated with jit/checkpoint, anything nested in either,
+  and anything nested in a ``make_*`` builder (this repo's idiom: every
+  ``make_*`` in the package returns a function the strategies jit).
+
+* ``host-sync-hot-path`` — ``.item()``, ``block_until_ready``,
+  ``np.asarray``/``jax.device_get`` in the step hot path (the loop
+  bodies nested in ``Trainer.train``): each forces a device→host sync
+  that stalls the async step pipeline PR 1 built. Sanctioned drain
+  points (``LossRecords``' parked-row pulls, nested fns named ``pull``)
+  are exempt; ``.item()``/``block_until_ready`` are additionally flagged
+  package-wide outside the sanctioned drain modules.
+
+* ``use-after-donation`` — a value passed in donated position (argument
+  0 of a ``*train_step``/``multi_step``/``accum_step`` call) is deleted
+  device memory after the call; reading it — or an alias bound from it
+  before the call — afterwards is a use-after-free on accelerators.
+
+* ``rank-gated-collective`` — a collective call lexically under an
+  ``if``/``while``/ternary whose test calls ``process_index()``: ranks
+  would trace different collective programs and deadlock at the first
+  unmatched one. (The jaxpr layer proves the same property dynamically
+  via dual-rank tracing; this rule points at the exact source line.)
+
+Suppression: append ``# dptlint: disable=<rule>[,<rule>...]`` (or
+``disable=all``) to the offending line, with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from distributedpytorch_tpu.analysis import Finding
+
+#: Call names whose function-valued arguments get traced by jax.
+TRACE_ENTRYPOINTS = frozenset({
+    "jit", "pmap", "vmap", "grad", "value_and_grad", "vjp", "jvp",
+    "checkpoint", "remat", "cond", "switch", "scan", "while_loop",
+    "shard_map", "eval_shape", "make_jaxpr", "custom_vjp", "custom_jvp",
+    "fori_loop", "associative_scan", "named_call",
+})
+
+#: Decorators that make the decorated function traced.
+TRACED_DECORATORS = frozenset({"jit", "checkpoint", "remat", "custom_vjp",
+                               "custom_jvp"})
+
+#: Which positional args of each entrypoint are callables that get
+#: traced (default: arg 0). Data operands (scan's init/xs, cond's
+#: operands) must NOT be marked — a data variable named like a host
+#: function elsewhere in the module would otherwise poison that
+#: function as "traced".
+CALLABLE_ARG_POSITIONS = {
+    "cond": (1, 2),       # cond(pred, true_fn, false_fn, *operands)
+    "switch": (1,),       # switch(index, branches, *operands)
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+}
+#: Keyword names that carry callables into trace entrypoints.
+CALLABLE_KEYWORDS = frozenset({"f", "fun", "fn", "body", "body_fun",
+                               "cond_fun", "branches"})
+
+#: Dotted-path prefixes/exacts that are nondeterministic under trace.
+NONDET_EXACT = frozenset({
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "os.urandom", "uuid.uuid4", "uuid.uuid1",
+})
+NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+#: Collective-issuing call names (terminal attribute) for the rank rule.
+COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmin", "pmax", "ppermute", "all_gather",
+    "all_to_all", "psum_scatter", "reduce_scatter", "process_allgather",
+    "pbroadcast",
+})
+
+#: Hot-path scope: (path suffix, enclosing function name). Everything
+#: lexically nested inside these functions is the step hot path.
+HOT_PATH_SCOPES: Tuple[Tuple[str, str], ...] = (
+    (os.path.join("train", "loop.py"), "train"),
+)
+#: Nested helpers inside the hot path that ARE the sanctioned drain
+#: points (LossRecords' lazy device→host pulls).
+SANCTIONED_DRAIN_FNS = frozenset({"pull"})
+#: Modules whose whole job is draining device values to the host —
+#: .item()/block_until_ready are legitimate there.
+SANCTIONED_SYNC_MODULES = (
+    "checkpoint.py", "evaluate.py",
+    os.path.join("utils", "metrics.py"),
+    os.path.join("utils", "trace.py"),
+)
+HOT_SYNC_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
+                            "numpy.array", "jax.device_get", "device_get"})
+
+#: Terminal names of calls that donate their first argument's buffers —
+#: the jitted step family the strategies build with donate_argnums
+#: (train/loop.py binds them as self.train_step/multi_step/accum_step).
+#: Deliberately NOT the `build_*`/`make_*` builders: those take (model,
+#: tx) and donate nothing.
+DONATING_CALLS = frozenset({"train_step", "multi_step", "accum_step"})
+
+
+def _donating_call(terminal: str) -> bool:
+    return terminal in DONATING_CALLS
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*dptlint:\s*disable=([\w\-]+(?:\s*,\s*[\w\-]+)*)"
+)
+
+
+def _suppressions(source: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """``np.random.default_rng`` -> "np.random.default_rng"; None when
+    the expression is not a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _expr_key(node: ast.AST) -> Optional[str]:
+    """Stable key for name/attribute chains ("state", "self.state")."""
+    return _dotted(node)
+
+
+@dataclasses.dataclass
+class _FnInfo:
+    node: ast.AST
+    name: str
+    parent: Optional[ast.AST]  # enclosing function node (not class)
+    traced: bool = False
+
+
+class _Scopes(ast.NodeVisitor):
+    """Function table with parent links plus the traced-function set."""
+
+    def __init__(self):
+        self.fns: Dict[ast.AST, _FnInfo] = {}
+        self._stack: List[ast.AST] = []
+        self.traced_names: Set[str] = set()
+
+    def _enter(self, node, name):
+        parent = self._stack[-1] if self._stack else None
+        self.fns[node] = _FnInfo(node=node, name=name, parent=parent)
+        self._stack.append(node)
+        self.generic_visit(node)
+        self._stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self._enter(node, "<lambda>")
+
+    def visit_Call(self, node):
+        term = _terminal(node.func)
+        if term in TRACE_ENTRYPOINTS:
+            positions = CALLABLE_ARG_POSITIONS.get(term, (0,))
+            candidates = [
+                node.args[i] for i in positions if i < len(node.args)
+            ] + [
+                kw.value for kw in node.keywords
+                if kw.arg in CALLABLE_KEYWORDS
+            ]
+            flat = []
+            for arg in candidates:
+                # switch's branches (and the `branches=` keyword) arrive
+                # as a literal list/tuple of callables — unpack it
+                if isinstance(arg, (ast.List, ast.Tuple)):
+                    flat.extend(arg.elts)
+                else:
+                    flat.append(arg)
+            for arg in flat:
+                if isinstance(arg, ast.Name):
+                    self.traced_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    # the Lambda node is visited after this call; mark it
+                    # by identity and resolve in _mark_traced
+                    self.traced_names.add(id(arg))  # type: ignore[arg-type]
+        self.generic_visit(node)
+
+
+def _mark_traced(scopes: _Scopes) -> None:
+    for info in scopes.fns.values():
+        node = info.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if info.name in scopes.traced_names:
+                info.traced = True
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if _terminal(base) in TRACED_DECORATORS:
+                    info.traced = True
+        if isinstance(node, ast.Lambda) and id(node) in scopes.traced_names:
+            info.traced = True
+    # propagate: nested in a traced fn, or nested in a make_* builder
+    changed = True
+    while changed:
+        changed = False
+        for info in scopes.fns.values():
+            if info.traced:
+                continue
+            parent = info.parent
+            while parent is not None:
+                pinfo = scopes.fns[parent]
+                if pinfo.traced or pinfo.name.startswith("make_"):
+                    info.traced = True
+                    changed = True
+                    break
+                parent = pinfo.parent
+
+
+def _enclosing_chain(scopes: _Scopes, node_to_fn: Dict[int, ast.AST],
+                     node: ast.AST) -> List[_FnInfo]:
+    """Innermost-first chain of enclosing functions for a node."""
+    fn = node_to_fn.get(id(node))
+    chain = []
+    while fn is not None:
+        info = scopes.fns[fn]
+        chain.append(info)
+        fn = info.parent
+    return chain
+
+
+def lint_source(source: str, rel_path: str) -> List[Finding]:
+    """Lint one file's source. ``rel_path`` appears in findings and
+    drives the path-scoped rules."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Finding(
+            rule="parse-error", where=f"{rel_path}:{exc.lineno or 0}",
+            message=f"file does not parse: {exc.msg}", layer="lint",
+        )]
+    suppressed = _suppressions(source)
+    scopes = _Scopes()
+    scopes.visit(tree)
+    _mark_traced(scopes)
+
+    # node -> innermost enclosing function node
+    node_to_fn: Dict[int, ast.AST] = {}
+
+    def index(node, current):
+        for child in ast.iter_child_nodes(node):
+            nxt = current
+            if child in scopes.fns:
+                nxt = child
+            node_to_fn[id(child)] = current
+            index(child, nxt)
+
+    index(tree, None)  # type: ignore[arg-type]
+
+    findings: List[Finding] = []
+
+    def emit(rule: str, node: ast.AST, message: str):
+        line = getattr(node, "lineno", 0)
+        rules = suppressed.get(line, set())
+        if rule in rules or "all" in rules:
+            return
+        findings.append(Finding(
+            rule=rule, where=f"{rel_path}:{line}", message=message,
+            layer="lint",
+        ))
+
+    in_hot_file = any(rel_path.endswith(sfx) for sfx, _fn in HOT_PATH_SCOPES)
+    hot_fn_names = {fn for sfx, fn in HOT_PATH_SCOPES
+                    if rel_path.endswith(sfx)}
+    sync_sanctioned_file = any(
+        rel_path.endswith(sfx) for sfx in SANCTIONED_SYNC_MODULES
+    )
+
+    def hot_context(chain: List[_FnInfo]) -> bool:
+        """Inside a hot-path scope and not inside a sanctioned drain."""
+        if not in_hot_file:
+            return False
+        names = [info.name for info in chain]
+        if any(n in SANCTIONED_DRAIN_FNS for n in names):
+            return False
+        return any(n in hot_fn_names for n in names)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        chain = _enclosing_chain(scopes, node_to_fn, node)
+        dotted = _dotted(node.func)
+        term = _terminal(node.func)
+
+        # -- trace-nondeterminism
+        traced = any(info.traced for info in chain)
+        if traced and dotted is not None:
+            if dotted in NONDET_EXACT or any(
+                dotted.startswith(p) for p in NONDET_PREFIXES
+            ):
+                emit(
+                    "trace-nondeterminism", node,
+                    f"`{dotted}` inside a traced function: it runs ONCE "
+                    f"at trace time and bakes a constant into the "
+                    f"compiled step — thread host randomness/time in as "
+                    f"an argument instead",
+                )
+
+        # -- host-sync: package-wide block_until_ready (both the method
+        # form `x.block_until_ready()` and the function form
+        # `jax.block_until_ready(x)`) and zero-arg `.item()`
+        blocks = term == "block_until_ready" or (
+            term == "item"
+            and isinstance(node.func, ast.Attribute)
+            and not node.args
+        )
+        if blocks and not sync_sanctioned_file:
+            emit(
+                "host-sync-hot-path", node,
+                f"`{dotted or term}` forces a device→host sync; only "
+                f"the sanctioned drain modules "
+                f"({', '.join(SANCTIONED_SYNC_MODULES)}) may block on "
+                f"device values",
+            )
+
+        # -- host-sync: hot-path scoped np.asarray/device_get
+        if dotted in HOT_SYNC_CALLS and hot_context(chain):
+            emit(
+                "host-sync-hot-path", node,
+                f"`{dotted}` in the step hot path stalls the async "
+                f"dispatch pipeline (one device sync per step) — route "
+                f"the value through LossRecords' parked-row drain or a "
+                f"sanctioned `pull` helper",
+            )
+
+    # -- use-after-donation (per function body, EXCLUDING nested defs:
+    # a load in a different closure has its own lifetime)
+    def walk_own_body(fn_node):
+        stack = list(ast.iter_child_nodes(fn_node))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                stack.extend(ast.iter_child_nodes(node))
+
+    for fn_node, info in scopes.fns.items():
+        if not isinstance(fn_node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        body_calls: List[Tuple[ast.Call, Optional[str]]] = []
+        assigns: List[ast.Assign] = []
+        for node in walk_own_body(fn_node):
+            if isinstance(node, ast.Assign):
+                assigns.append(node)
+            if isinstance(node, ast.Call):
+                term = _terminal(node.func)
+                if term and _donating_call(term) and node.args:
+                    body_calls.append((node, _expr_key(node.args[0])))
+        for call, donated in body_calls:
+            if donated is None:
+                continue
+            call_line = call.lineno
+            # aliases bound from the donated expr BEFORE the call
+            aliases = {
+                t.id
+                for a in assigns
+                if a.lineno < call_line and _expr_key(a.value) == donated
+                for t in a.targets
+                if isinstance(t, ast.Name)
+            }
+            # is the donated expr rebound by the call's own statement?
+            # Matched by the CALL NODE living inside the assignment's
+            # value expression, not by line number — a line-wrapped
+            # `self.state, loss = (\n    self.train_step(...))` must
+            # still count as a rebind.
+            rebound_at_call = any(
+                any(sub is call for sub in ast.walk(a.value)) and any(
+                    donated in {
+                        _expr_key(el) for el in (
+                            t.elts if isinstance(t, ast.Tuple) else [t]
+                        )
+                    }
+                    for t in a.targets
+                )
+                for a in assigns
+            )
+            for node in walk_own_body(fn_node):
+                line = getattr(node, "lineno", 0)
+                if line <= call_line:
+                    continue
+                if isinstance(node, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(node, "ctx", None), ast.Load
+                ):
+                    key = _expr_key(node)
+                    if key == donated and not rebound_at_call:
+                        emit(
+                            "use-after-donation", node,
+                            f"`{donated}` was passed in donated position "
+                            f"to `{_terminal(call.func)}` at line "
+                            f"{call_line}; its buffers are deleted on "
+                            f"accelerators — rebind the result instead of "
+                            f"re-reading the donated value",
+                        )
+                    elif key in aliases:
+                        emit(
+                            "use-after-donation", node,
+                            f"`{key}` aliases `{donated}`, which was "
+                            f"donated to `{_terminal(call.func)}` at line "
+                            f"{call_line}; reading the alias afterwards "
+                            f"is a use-after-free unless donation is "
+                            f"provably disabled on this path",
+                        )
+
+    # -- rank-gated-collective
+    def test_calls_process_index(test: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call) and _terminal(n.func) == "process_index"
+            for n in ast.walk(test)
+        )
+
+    for node in ast.walk(tree):
+        branches: List[ast.AST] = []
+        if isinstance(node, (ast.If, ast.While)) and test_calls_process_index(
+            node.test
+        ):
+            branches = list(node.body) + list(node.orelse)
+        elif isinstance(node, ast.IfExp) and test_calls_process_index(
+            node.test
+        ):
+            branches = [node.body, node.orelse]
+        for br in branches:
+            for sub in ast.walk(br):
+                if isinstance(sub, ast.Call) and _terminal(
+                    sub.func
+                ) in COLLECTIVE_CALLS:
+                    emit(
+                        "rank-gated-collective", sub,
+                        f"`{_dotted(sub.func) or _terminal(sub.func)}` is "
+                        f"guarded by a process_index() conditional — ranks "
+                        f"trace different collective programs and deadlock "
+                        f"at the first unmatched collective; issue the "
+                        f"collective on every rank (gate only the use of "
+                        f"its result)",
+                    )
+
+    return findings
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    rel = os.path.relpath(path, root) if root else path
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), rel)
+
+
+SKIP_DIRS = frozenset({"__pycache__", "native"})
+
+
+def lint_package(root: Optional[str] = None) -> Tuple[List[Finding], int]:
+    """Lint every ``.py`` under ``root`` (default: this package).
+    Returns ``(findings, files_linted)``."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    findings: List[Finding] = []
+    n = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in sorted(dirnames) if d not in SKIP_DIRS]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            n += 1
+            findings.extend(
+                lint_file(os.path.join(dirpath, fname),
+                          root=os.path.dirname(root))
+            )
+    return findings, n

@@ -33,6 +33,16 @@ process CPU/gloo mesh (tests/test_elastic.py):
     ``--min-ranks``), and the checkpoint saved on N processes reshards
     onto the M-process mesh.
 
+  * **static preflight** — before the first spawn, the job's strategy ×
+    schedule runs through the static distributed-correctness analyzer
+    (``python -m distributedpytorch_tpu analyze`` in a provisioned CPU
+    subprocess, docs/ANALYSIS.md): a statically-deadlocked schedule or a
+    rank-divergent collective would otherwise spawn N ranks that hang
+    until the heartbeat window expires and burn the whole restart budget
+    relaunching into the same hang. Findings refuse the launch
+    (``STATIC_CHECK_EXIT``); analyzer infra failures never block;
+    ``--no-preflight`` overrides.
+
 Chaos drills: ``--chaos SITE[@RANK]:EPOCH:STEP[:COUNT]`` arms a fault
 (utils/faults.py — ``rank_kill`` / ``rank_hang`` live in the step loop)
 via ``--inject-fault`` on the FIRST attempt only, so the relaunched
@@ -68,6 +78,10 @@ logger = logging.getLogger(__name__)
 #: failure to the primary rank, not to survivors that died of it.
 PEER_FAILURE_EXIT = 13
 
+#: Supervisor rc when the static preflight (analysis/, docs/ANALYSIS.md)
+#: found the job's step program statically broken: nothing was spawned.
+STATIC_CHECK_EXIT = 3
+
 
 def _free_port() -> int:
     with socket.socket() as s:
@@ -75,17 +89,36 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _worker_arg(args: Sequence[str], names: Sequence[str], default: str) -> str:
+def _worker_arg(args: Sequence[str], names: Sequence[str], default: str,
+                abbrev: bool = False) -> str:
     """Pull a flag value out of the worker argv (last occurrence wins,
-    like argparse). Supports ``--flag value`` and ``--flag=value``."""
+    like argparse). Supports ``--flag value`` and ``--flag=value``;
+    ``abbrev`` additionally accepts argparse-style prefix spellings
+    (``--pipeline-sched 1f1b``) — the trainer's parser allows them, so
+    a supervisor that only matched the full spelling would silently
+    read its default instead of the schedule the workers actually run."""
     value = default
     args = list(args)
+
+    def matches(flag: str, name: str) -> bool:
+        if flag == name:
+            return True
+        return (abbrev and flag.startswith("--") and len(flag) >= 4
+                and name.startswith(flag))
+
     for i, a in enumerate(args):
+        flag, eq, rest = a.partition("=")
         for n in names:
-            if a == n and i + 1 < len(args):
-                value = args[i + 1]
-            elif a.startswith(n + "="):
-                value = a.split("=", 1)[1]
+            if (len(n) == 2 and not n.startswith("--")
+                    and a.startswith(n) and a != n):
+                # glued short form: argparse reads -tMP as -t with value
+                # "MP" — and -t=X as value "=X", the '=' taken verbatim
+                value = a[len(n):]
+            elif matches(flag, n):
+                if eq:
+                    value = rest
+                elif i + 1 < len(args):
+                    value = args[i + 1]
     return value
 
 
@@ -141,6 +174,8 @@ class ElasticSupervisor:
         chaos: Sequence[str] = (),
         env: Optional[Dict[str, str]] = None,
         cwd: Optional[str] = None,
+        preflight: bool = True,
+        preflight_timeout_s: float = 300.0,
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -175,12 +210,20 @@ class ElasticSupervisor:
         self.chaos = tuple(chaos)
         self.base_env = dict(env) if env is not None else None
         self.cwd = cwd  # workers' cwd (their relative artifact dirs)
+        self.preflight = bool(preflight)
+        self.preflight_timeout_s = float(preflight_timeout_s)
+        self.preflight_findings: List[str] = []
 
         # resume coordinates, parsed from the worker argv (the trainer's
         # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt)
         self.method_tag = _worker_arg(
-            self.worker_args, ("-t", "--train-method"), "singleGPU"
+            self.worker_args, ("-t", "--train-method"), "singleGPU",
+            abbrev=True,
         )
+        # exact-only on purpose: the trainer has a DISTINCT exact flag
+        # --checkpoint (load a .pth), which argparse resolves to itself
+        # but a prefix match would misread as --checkpoint-dir and break
+        # resume (relaunch would probe <cwd>/model.pth for checkpoints)
         ckpt_dir = _worker_arg(
             self.worker_args, ("--checkpoint-dir",), "./checkpoints"
         )
@@ -365,6 +408,56 @@ class ElasticSupervisor:
             time.sleep(self.poll_interval_s)
 
     # ------------------------------------------------------------------
+    def static_preflight(self) -> List[str]:
+        """Run the static distributed-correctness analyzer over this
+        job's strategy × schedule BEFORE spawning any rank: a step whose
+        collective program is statically broken (deadlocked ppermute
+        schedule, rank-divergent collective, dropped gradient reduction)
+        would otherwise spawn N ranks that hang until the heartbeat
+        window expires, burn the whole restart budget relaunching into
+        the same hang, and exit having attributed the failure to
+        "hung" ranks instead of the program.
+
+        Returns the findings lines (empty = clean). Scoped to the
+        COLLECTIVE layer for this job's strategy × schedule: a source
+        lint nit anywhere in the package is CI's gate, not a reason to
+        refuse an otherwise-sound launch. Stays jax-free: the analyzer
+        runs via the shared runner (analysis/preflight.py — ``python -m
+        distributedpytorch_tpu analyze`` in a provisioned CPU
+        subprocess), so the supervisor never initializes a backend or
+        dials a TPU runtime. Analyzer infrastructure failures (rc !=
+        0/1, timeout) return [] — availability first: the supervisor
+        must never refuse a launch because the analyzer itself broke.
+
+        Strategies the analyzer doesn't cover (``singleGPU``, the
+        multi-process-only ``DDP``) skip the check entirely — same
+        rationale as bench_multi's ``_preflight_combos``: nothing to
+        verify statically, so don't pay a provisioned analyzer
+        subprocess on every launch of a non-collective job."""
+        from distributedpytorch_tpu.analysis import ANALYSIS_STRATEGIES
+        from distributedpytorch_tpu.analysis.preflight import run_preflight
+
+        if self.method_tag not in ANALYSIS_STRATEGIES:
+            return []
+        schedule = _worker_arg(
+            self.worker_args, ("--pipeline-schedule",), "gpipe",
+            abbrev=True,
+        )
+        rc, findings = run_preflight(
+            [self.method_tag], [schedule], self.preflight_timeout_s,
+            layer="collectives", base_env=self.base_env, cwd=self.cwd,
+        )
+        if rc == 1:
+            return findings
+        if rc != 0:
+            logger.warning(
+                "elastic: static preflight could not run (rc=%d) — "
+                "proceeding with the launch: %.300s",
+                rc, "; ".join(findings),
+            )
+        return []
+
+    # ------------------------------------------------------------------
     def _write_report(self, final: Optional[str] = None) -> None:
         os.makedirs(
             os.path.dirname(os.path.abspath(self.report_path)), exist_ok=True
@@ -375,6 +468,8 @@ class ElasticSupervisor:
             "final": final,
             "attempts": [dataclasses.asdict(a) for a in self.attempts],
         }
+        if self.preflight_findings:
+            payload["preflight_findings"] = list(self.preflight_findings)
         tmp = f"{self.report_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=2)
@@ -384,7 +479,21 @@ class ElasticSupervisor:
         """Supervise to completion. Returns 0 when an attempt finishes
         with every rank at exit 0; 1 when the restart budget is
         exhausted (the report JSON holds the full per-attempt record
-        either way)."""
+        either way); STATIC_CHECK_EXIT (3) when the static preflight
+        refused the launch — no rank was spawned and no budget spent."""
+        if self.preflight:
+            self.preflight_findings = self.static_preflight()
+            if self.preflight_findings:
+                for line in self.preflight_findings:
+                    logger.error("elastic: static preflight: %s", line)
+                logger.error(
+                    "elastic: refusing to spawn %d rank(s): the step "
+                    "fails static distributed-correctness checks (see "
+                    "docs/ANALYSIS.md; --no-preflight overrides)",
+                    self.nprocs,
+                )
+                self._write_report(final="static_check_failed")
+                return STATIC_CHECK_EXIT
         world = self.nprocs
         attempt = 0
         consecutive_fails = {r: 0 for r in range(world)}
@@ -509,6 +618,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="Arm a fault (--inject-fault) on the FIRST "
                          "attempt only — drills the detect/relaunch path "
                          "without re-killing the relaunched job")
+    ap.add_argument("--no-preflight", action="store_true",
+                    help="Skip the static distributed-correctness "
+                         "preflight (python -m distributedpytorch_tpu "
+                         "analyze over this job's strategy/schedule in a "
+                         "CPU subprocess) that otherwise runs before any "
+                         "rank is spawned")
+    ap.add_argument("--preflight-timeout", type=float, default=300.0,
+                    help="Preflight subprocess budget (s); an analyzer "
+                         "that cannot run never blocks the launch")
     ap.add_argument("worker_args", nargs=argparse.REMAINDER,
                     help="Training CLI args (prefix with --)")
     args = ap.parse_args(argv)
@@ -534,6 +652,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         report_path=args.report,
         cpu_devices=args.cpu_devices,
         chaos=args.chaos,
+        preflight=not args.no_preflight,
+        preflight_timeout_s=args.preflight_timeout,
     )
     return sup.run()
 

@@ -339,6 +339,15 @@ def _combine_bn(model_state, bn_final, stage_axis, data_axis):
     return jax.tree.map(combine, model_state, bn_final)
 
 
+def _reduce_grads(grads, axes):
+    """Close the schedule: each stage holds only its own segments'
+    gradient leaves (zeros elsewhere), so the stage psum assembles the
+    full gradient and the 'data' psum is the DDP all-reduce. A named
+    seam so the static analyzer's mutation tests (tests/test_analysis.py)
+    can drop an axis and prove the comms-contract check catches it."""
+    return jax.lax.psum(grads, axes)
+
+
 def _stats_fn(use_pallas: bool):
     if use_pallas:
         from distributedpytorch_tpu.ops.fused_loss import bce_dice_stats_fused
@@ -652,10 +661,7 @@ def make_pipeline_value_and_grad_fn(
                 if out_bwd[e] is not None else zero_payloads[e]
                 for e in range(S - 1)
             ]
-        # each stage holds only its own segments' gradient leaves (zeros
-        # elsewhere): the stage psum assembles the full gradient; the data
-        # psum is the DDP all-reduce.
-        grads = jax.lax.psum(grads, axes)
+        grads = _reduce_grads(grads, axes)
         return loss, grads, new_model_state
 
     if stateful:

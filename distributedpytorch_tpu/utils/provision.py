@@ -16,7 +16,7 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
-from typing import Mapping, MutableMapping, Optional
+from typing import Mapping, MutableMapping, NoReturn, Optional, Sequence
 
 
 def provisioned_env(
@@ -62,3 +62,17 @@ def maybe_reexec_provisioned(
         [sys.executable, "-u", os.path.abspath(sys.argv[0])] + sys.argv[1:],
         env=env,
     ).returncode
+
+
+def reexec_provisioned_cmd(n_devices: int, sentinel: str,
+                           cmd: Sequence[str]) -> NoReturn:
+    """Replace THIS process with ``cmd`` under ``provisioned_env`` —
+    ``os.execvpe``, not a child process. The caller's PID is preserved,
+    so whatever supervises it (CI's ``timeout``, a shell) signals the
+    provisioned interpreter directly: there is no intermediate parent
+    whose death would orphan a still-running child. For entry points
+    that re-run a command rather than ``sys.argv`` as a script (the
+    ``analyze`` CLI re-runs ``-m distributedpytorch_tpu``)."""
+    env = provisioned_env(n_devices)
+    env[sentinel] = "1"
+    os.execvpe(cmd[0], list(cmd), env)

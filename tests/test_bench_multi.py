@@ -322,6 +322,108 @@ class TestMainLoop:
         assert "BENCH_PIPELINE_SWEEP" not in os.environ  # snapshot restored
 
 
+class TestStaticPreflight:
+    """The chip-window preflight (ISSUE 5): a config whose step fails
+    static checks is poison-marked with a ``static_check_failed``
+    provenance line BEFORE any budget is spent — no attempting marker,
+    no watchdog, no bench run; analyzer infra failures never block."""
+
+    def test_static_check_failed_is_poison_in_load_state(self, tmp_path):
+        p = tmp_path / "a.jsonl"
+        _write(p, [
+            {"config": "pipeline_sched_sweep",
+             "error": "static_check_failed: [ppermute-deadlock] "
+                      "MP/1f1b train step: tick-program deadlock"},
+        ])
+        assert bench_multi.load_state(str(p)) == {
+            "pipeline_sched_sweep": "poison"}
+
+    def test_failing_preflight_poisons_without_spending_budget(
+            self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("sweep", {"BENCH_PIPELINE_SWEEP": "1"}, 300.0),
+                   ("a", {}, 60.0)]
+        mod = TestMainLoop._fake_bench(None, [{"value": 1.0}])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+        finding = ("[ppermute-deadlock] MP/1f1b train step: "
+                   "tick-program deadlock: flipped edge")
+        calls = []
+
+        def fake_analyze(strategies, schedules, timeout):
+            calls.append((tuple(strategies), tuple(schedules)))
+            return 1, [finding]
+
+        monkeypatch.setattr(bench_multi, "_run_analyze", fake_analyze)
+        # the sweep must never be dispatched
+        import tools.bench_pipeline as bp
+
+        def no_sweep(budget_s=0.0):
+            raise AssertionError("poisoned config spent chip budget")
+
+        monkeypatch.setattr(bp, "schedule_sweep", no_sweep)
+        rc = bench_multi.main(["--out", out])
+        assert rc == 0  # sweep poisoned (terminal) + a measured
+        assert calls == [(("MP",), ("gpipe", "1f1b"))]
+        state = bench_multi.load_state(out)
+        assert state == {"sweep": "poison", "a": "ok"}
+        lines = _lines(out)
+        poison = [d for d in lines
+                  if d.get("config") == "sweep" and "error" in d]
+        assert poison[0]["error"].startswith("static_check_failed")
+        assert poison[0]["findings"] == [finding]
+        # no budget spent: the config never even reached "attempting"
+        assert not any(
+            d.get("event") == "attempting" and d.get("config") == "sweep"
+            for d in lines
+        )
+
+    def test_clean_preflight_lets_the_sweep_run(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("sweep", {"BENCH_PIPELINE_SWEEP": "1"}, 300.0)]
+        mod = TestMainLoop._fake_bench(None, [])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+        monkeypatch.setattr(
+            bench_multi, "_run_analyze", lambda *a: (0, []))
+        import tools.bench_pipeline as bp
+
+        monkeypatch.setattr(
+            bp, "schedule_sweep",
+            lambda budget_s=0.0: {"kind": "pipeline_schedule_sweep"})
+        assert bench_multi.main(["--out", out]) == 0
+        assert bench_multi.load_state(out) == {"sweep": "ok"}
+
+    def test_analyzer_infra_failure_never_blocks(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("sweep", {"BENCH_PIPELINE_SWEEP": "1"}, 300.0)]
+        mod = TestMainLoop._fake_bench(None, [])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+        monkeypatch.setattr(
+            bench_multi, "_run_analyze",
+            lambda *a: (2, ["analyzer did not run: TimeoutExpired"]))
+        import tools.bench_pipeline as bp
+
+        monkeypatch.setattr(
+            bp, "schedule_sweep",
+            lambda budget_s=0.0: {"kind": "pipeline_schedule_sweep"})
+        assert bench_multi.main(["--out", out]) == 0
+        assert bench_multi.load_state(out) == {"sweep": "ok"}
+
+    def test_non_distributed_configs_skip_the_preflight(
+            self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {"BENCH_BATCH": "8"}, 60.0)]
+        mod = TestMainLoop._fake_bench(None, [{"value": 1.0}])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+
+        def never(*a):
+            raise AssertionError("preflight ran for a collective-free "
+                                 "single-device config")
+
+        monkeypatch.setattr(bench_multi, "_run_analyze", never)
+        assert bench_multi.main(["--out", out]) == 0
+        assert bench_multi.load_state(out) == {"a": "ok"}
+
+
 class TestSupervisorRestarts:
     """Window reports carry the elastic supervisor's restart count, so a
     flapping chip window (job survived via relaunches) reads differently

@@ -26,6 +26,7 @@ import textwrap
 import pytest
 
 from distributedpytorch_tpu.dist.elastic import (
+    STATIC_CHECK_EXIT,
     ElasticSupervisor,
     _checkpoint_exists,
     _worker_arg,
@@ -101,6 +102,258 @@ class TestWorkerArgPlumbing:
             if isinstance(node, (ast.Import, ast.ImportFrom))
         }
         assert not any("jax" in (m or "") for m in imported)
+
+
+# ---------------------------------------------------------------------------
+# Fast: the static launch preflight (ISSUE 5) — the supervisor refuses to
+# spawn ranks whose step program fails static distributed-correctness
+# checks, and analyzer infrastructure failures never block a launch
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerArgParsing:
+    def test_exact_checkpoint_flag_not_misread_as_checkpoint_dir(
+        self, tmp_path
+    ):
+        # --checkpoint (load a .pth) is a DISTINCT exact trainer flag;
+        # prefix-matching it into --checkpoint-dir would point the
+        # relaunch's resume probe at <cwd>/model.pth and silently
+        # restart training from scratch (review regression)
+        sup = ElasticSupervisor(
+            ["-t", "FSDP", "--checkpoint", "model.pth"],
+            nprocs=2, run_dir=str(tmp_path / "run"),
+        )
+        assert sup.checkpoint_dir.endswith("checkpoints")
+
+    def test_abbreviated_strategy_flag_resolves_method_tag(self, tmp_path):
+        # the trainer's argparse accepts prefix spellings; the
+        # supervisor's method_tag gates the static preflight, so a
+        # fallback to singleGPU would silently skip the gate
+        sup = ElasticSupervisor(
+            ["--train-meth", "DDP_MP"],
+            nprocs=2, run_dir=str(tmp_path / "run"),
+        )
+        assert sup.method_tag == "DDP_MP"
+
+    def test_glued_short_strategy_flag_resolves_method_tag(self, tmp_path):
+        # argparse's glued short form (-tMP) is equally valid worker
+        # argv — missing it falls back to singleGPU, which silently
+        # skips the preflight gate AND breaks relaunch resume (the
+        # checkpoint probe would look for singleGPU.ckpt) (review
+        # regression)
+        sup = ElasticSupervisor(
+            ["-tMP"], nprocs=2, run_dir=str(tmp_path / "run"),
+        )
+        assert sup.method_tag == "MP"
+
+
+class TestStaticPreflight:
+    def _sup(self, tmp_path, worker_args=("-t", "DDP_MP"), **kw):
+        defaults = dict(nprocs=2, run_dir=str(tmp_path / "run"))
+        defaults.update(kw)
+        return ElasticSupervisor(list(worker_args), **defaults)
+
+    def test_findings_refuse_launch_before_any_spawn(
+        self, tmp_path, monkeypatch
+    ):
+        sup = self._sup(tmp_path)
+        monkeypatch.setattr(
+            ElasticSupervisor, "static_preflight",
+            lambda self: ["[ppermute-deadlock] MP/1f1b train step: boom"],
+        )
+
+        def no_spawn(*a, **k):
+            raise AssertionError("spawned a rank past a failed preflight")
+
+        monkeypatch.setattr(ElasticSupervisor, "_spawn", no_spawn)
+        assert sup.run() == STATIC_CHECK_EXIT
+        report = json.load(open(sup.report_path))
+        assert report["final"] == "static_check_failed"
+        assert report["preflight_findings"] == [
+            "[ppermute-deadlock] MP/1f1b train step: boom"
+        ]
+        assert report["attempts"] == []  # no budget, no world history
+
+    def test_no_preflight_flag_skips_the_check(self, tmp_path, monkeypatch):
+        sup = self._sup(tmp_path, preflight=False)
+
+        def never(self):
+            raise AssertionError("preflight ran despite preflight=False")
+
+        monkeypatch.setattr(ElasticSupervisor, "static_preflight", never)
+        # reaching _spawn proves the preflight gate was bypassed
+        sentinel = RuntimeError("reached spawn")
+
+        def spawn(*a, **k):
+            raise sentinel
+
+        monkeypatch.setattr(ElasticSupervisor, "_spawn", spawn)
+        with pytest.raises(RuntimeError, match="reached spawn"):
+            sup.run()
+
+    def test_preflight_command_carries_strategy_and_schedule(
+        self, tmp_path, monkeypatch
+    ):
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(
+            tmp_path,
+            worker_args=["-t", "DDP_MP", "--pipeline-schedule", "1f1b"],
+        )
+        seen = {}
+
+        class Done:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        def fake_run(cmd, env=None, **kw):
+            seen["cmd"] = cmd
+            seen["env"] = env
+            return Done()
+
+        monkeypatch.setattr(preflight_mod.subprocess, "run", fake_run)
+        assert sup.static_preflight() == []
+        cmd = seen["cmd"]
+        assert cmd[-4:] == ["--strategies", "DDP_MP", "--schedules", "1f1b"]
+        assert "analyze" in cmd
+        # collective layer only: a package-wide lint nit must never
+        # refuse an otherwise-sound launch (that's CI's gate)
+        assert cmd[cmd.index("--layer") + 1] == "collectives"
+        # provisioned: CPU-pinned, never dialing the TPU relay
+        assert seen["env"]["JAX_PLATFORMS"] == "cpu"
+        assert seen["env"]["PALLAS_AXON_POOL_IPS"] == ""
+        assert seen["env"]["DPT_ANALYZE_PROVISIONED"] == "1"
+
+    def test_preflight_follows_abbreviated_schedule_flag(
+        self, tmp_path, monkeypatch
+    ):
+        # the trainer's argparse accepts prefix spellings
+        # (--train-meth DDP_MP --pipeline-sched 1f1b); the preflight
+        # must validate the strategy × schedule the workers actually
+        # run — falling back to singleGPU would skip the gate entirely,
+        # falling back to gpipe would validate the wrong program
+        # (review regressions)
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(
+            tmp_path,
+            worker_args=["--train-meth", "DDP_MP",
+                         "--pipeline-sched", "1f1b"],
+        )
+        seen = {}
+
+        class Done:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        def fake_run(cmd, env=None, **kw):
+            seen["cmd"] = cmd
+            return Done()
+
+        monkeypatch.setattr(preflight_mod.subprocess, "run", fake_run)
+        assert sup.static_preflight() == []
+        cmd = seen["cmd"]
+        assert cmd[-4:] == ["--strategies", "DDP_MP", "--schedules", "1f1b"]
+
+    def test_findings_parsed_from_json_report(self, tmp_path, monkeypatch):
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(tmp_path)
+
+        class Found:
+            returncode = 1
+            stdout = json.dumps({"findings": [
+                {"rule": "comms-contract", "where": "DDP_MP/1f1b train step",
+                 "message": "no psum over ['data', 'stage']"},
+            ]})
+            stderr = ""
+
+        monkeypatch.setattr(
+            preflight_mod.subprocess, "run", lambda *a, **k: Found())
+        assert sup.static_preflight() == [
+            "[comms-contract] DDP_MP/1f1b train step: "
+            "no psum over ['data', 'stage']"
+        ]
+
+    def test_analyzer_infra_failure_never_blocks(self, tmp_path, monkeypatch):
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(tmp_path)
+
+        class Infra:
+            returncode = 2
+            stdout = ""
+            stderr = "analyze: infrastructure failure: boom"
+
+        monkeypatch.setattr(
+            preflight_mod.subprocess, "run", lambda *a, **k: Infra())
+        assert sup.static_preflight() == []
+
+        def timeout_run(*a, **k):
+            raise preflight_mod.subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+        monkeypatch.setattr(preflight_mod.subprocess, "run", timeout_run)
+        assert sup.static_preflight() == []
+
+    def test_crashed_interpreter_rc1_is_infra_not_findings(
+        self, tmp_path, monkeypatch
+    ):
+        # a Python-level crash (import error, traceback) also exits 1,
+        # with no JSON report — that's an INFRA failure and must
+        # proceed, not refuse the launch (review regression)
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(tmp_path)
+
+        class Crashed:
+            returncode = 1
+            stdout = ""
+            stderr = ("Traceback (most recent call last):\n"
+                      "ModuleNotFoundError: No module named "
+                      "'distributedpytorch_tpu'")
+
+        monkeypatch.setattr(
+            preflight_mod.subprocess, "run", lambda *a, **k: Crashed())
+        assert sup.static_preflight() == []
+
+    def test_malformed_report_shape_still_refuses_without_crashing(
+        self, tmp_path, monkeypatch
+    ):
+        # rc 1 with a report that parses as JSON but not the expected
+        # shape (version-skewed analyzer): the launch must still be
+        # refused with the fallback line, never crash the supervisor
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(tmp_path)
+        for bad_stdout in ("null", '{"findings": ["a bare string"]}'):
+            class Skewed:
+                returncode = 1
+                stdout = bad_stdout
+                stderr = ""
+
+            monkeypatch.setattr(
+                preflight_mod.subprocess, "run", lambda *a, **k: Skewed())
+            assert sup.static_preflight() == [
+                "analyzer reported findings but the JSON report was "
+                "unreadable"
+            ]
+
+    def test_non_collective_strategy_skips_the_analyzer(
+        self, tmp_path, monkeypatch
+    ):
+        # singleGPU runs no collectives — the analyzer has nothing to
+        # verify, so the launch must not pay a provisioned subprocess
+        # (mirrors bench_multi._preflight_combos returning no combos).
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        def no_subprocess(*a, **k):
+            raise AssertionError("analyzer subprocess ran for singleGPU")
+
+        monkeypatch.setattr(preflight_mod.subprocess, "run", no_subprocess)
+        sup = self._sup(tmp_path, worker_args=("-t", "singleGPU"))
+        assert sup.static_preflight() == []
 
 
 # ---------------------------------------------------------------------------
@@ -182,6 +435,10 @@ def _stub_supervisor(tmp_path, nprocs, rank_behaviors, **kw):
         teardown_grace_s=2.0,
         spawn_timeout_s=30.0,
         run_dir=str(tmp_path / "run"),
+        # stub workers aren't training jobs — the static preflight is
+        # exercised by TestStaticPreflight, not paid by every state
+        # machine test (~8 s of analyzer subprocess each)
+        preflight=False,
     )
     defaults.update(kw)
     return ElasticSupervisor(args, **defaults)
@@ -321,6 +578,9 @@ def _real_supervisor(tmp_path, args, extra_env=None, **kw):
         run_dir=str(tmp_path / "run"),
         cwd=str(cwd),
         env=env,
+        # chaos drills measure detection/relaunch, not static analysis;
+        # preflight behavior has its own tests (TestStaticPreflight)
+        preflight=False,
     )
     defaults.update(kw)
     return ElasticSupervisor(args, **defaults)

@@ -5,12 +5,13 @@ communication actually happens — a strategy that silently degenerated to
 full per-device replication would still pass numerics, but its HLO would
 have no (or the wrong) collectives.
 
-Expected comms (verified against XLA's output on the 8-device CPU mesh):
-  DP    → all-reduce             (gradient reduction)
-  SP    → collective-permute     (halo exchange of boundary rows per conv)
-  TP    → channel resharding     (all-to-all / all-gather / permute)
-  FSDP  → all-gather             (per-layer parameter gathering)
-  MP    → collective-permute     (ppermute stage0→stage1 transfers)
+The expected-comms table is DATA the static analyzer owns
+(analysis/collectives.EXPECTED_HLO_COLLECTIVES / TP_HLO_ANY_OF — the
+same contract ``python -m distributedpytorch_tpu analyze --hlo``
+enforces); this test imports it and keeps its own compile + regex as an
+independent cross-check of the same declarations: the analyzer verifying
+its own table with its own extractor would prove nothing if the
+extractor were wrong.
 """
 
 import re
@@ -20,6 +21,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributedpytorch_tpu.analysis.collectives import (
+    EXPECTED_HLO_COLLECTIVES,
+    TP_HLO_ANY_OF,
+)
 from distributedpytorch_tpu.config import TrainConfig
 from distributedpytorch_tpu.models.unet import UNet
 from distributedpytorch_tpu.parallel import build_strategy
@@ -63,12 +68,10 @@ def _compiled_collectives(method):
 
 @pytest.mark.parametrize(
     "method,required",
-    [
-        ("DP", {"all-reduce"}),
-        ("SP", {"collective-permute"}),  # the conv halo exchanges
-        ("FSDP", {"all-gather"}),  # param gathering (ZeRO)
-        ("MP", {"collective-permute"}),  # ppermute stage transfers
-    ],
+    # EVERY row of the analyzer's contract table, verified here by an
+    # INDEPENDENT compile + regex — the --hlo analyzer tier is opt-in,
+    # so this test is what enforces the table on every push
+    sorted((m, set(req)) for m, req in EXPECTED_HLO_COLLECTIVES.items()),
 )
 def test_strategy_hlo_contains_collectives(method, required):
     ops = _compiled_collectives(method)
@@ -80,4 +83,4 @@ def test_tp_hlo_reshards_channels():
     all-to-all, all-gather, or permutes depending on version; any of them
     proves channels are genuinely distributed."""
     ops = _compiled_collectives("TP")
-    assert ops & {"all-to-all", "all-gather", "collective-permute"}, ops
+    assert ops & TP_HLO_ANY_OF, ops

@@ -99,8 +99,17 @@ CONFIGS = [
 # can never leak from one config into the next.
 _CONFIG_ENV_KEYS = sorted({k for _, env, _ in CONFIGS for k in env})
 
-_POISON_PREFIXES = ("watchdog", "wedged_previous_attempt")
+_POISON_PREFIXES = ("watchdog", "wedged_previous_attempt",
+                    "static_check_failed")
 _INNOCENT_PREFIX = "runtime_error"
+
+# Static-analysis preflight (distributedpytorch_tpu/analysis, docs/
+# ANALYSIS.md): a config whose step program fails the jaxpr collective
+# checker would burn its whole budget on a deadlocked schedule or a
+# silently-degenerated strategy — poison-mark it BEFORE spending chip
+# time. The analyzer runs in a provisioned CPU subprocess (utils/
+# provision.py): zero chip involvement, works on any window size.
+PREFLIGHT_TIMEOUT_S = 300.0
 
 # Liveness re-probe backoff after a retryable config failure: the relay
 # runtime is known to FLAP briefly (seconds to a couple of minutes) —
@@ -218,6 +227,58 @@ def _reprobe_with_backoff(probe_once, timeout: float) -> dict:
     return probe
 
 
+def _preflight_combos(env: dict):
+    """Which strategy × schedule combos a config's step will exercise —
+    what the static preflight must clear. Single-device bench configs
+    run no collectives (nothing to check statically, and the analyzer's
+    lint layer is CI's job, not a chip window's); the pipeline schedule
+    sweep traces the MP schedules the analyzer owns."""
+    if env.get("BENCH_PIPELINE_SWEEP") == "1":
+        return (("MP", ("gpipe", "1f1b")),)
+    return ()
+
+
+def _run_analyze(strategies, schedules, timeout: float):
+    """Invoke the analyzer in a provisioned CPU subprocess (the shared
+    runner: analysis/preflight.py); returns (rc, findings_lines). rc 2
+    (or a crashed/timed-out analyzer) is an INFRA failure — the caller
+    must treat it as clean rather than block a measurement on analyzer
+    plumbing. A thin module-level seam so tests can stub it."""
+    from distributedpytorch_tpu.analysis.preflight import run_preflight
+
+    return run_preflight(strategies, schedules, timeout)
+
+
+def _static_preflight(name: str, env: dict, out_path: str) -> bool:
+    """True = the config may spend chip budget; False = it failed static
+    checks and was poison-marked (``static_check_failed`` provenance, a
+    _POISON_PREFIXES member — never retried, like any other config that
+    would wedge a window). Analyzer infra failures never block."""
+    combos = _preflight_combos(env)
+    if not combos:
+        return True
+    for strategies_schedules in combos:
+        strategy, schedules = strategies_schedules
+        rc, findings = _run_analyze([strategy], list(schedules),
+                                    PREFLIGHT_TIMEOUT_S)
+        if rc == 0:
+            continue
+        if rc == 1 and findings:
+            append_line(out_path, {
+                "config": name,
+                "error": f"static_check_failed: {findings[0]}",
+                "findings": findings,
+            })
+            print(f"bench_multi: static preflight FAILED for {name!r} "
+                  f"({len(findings)} finding(s)) — poison-marked, no "
+                  f"budget spent: {findings[0]}")
+            return False
+        print(f"bench_multi: static preflight for {name!r} could not run "
+              f"(rc={rc}) — proceeding: "
+              f"{findings[0] if findings else 'no detail'}")
+    return True
+
+
 def _arm_config_watchdog(path: str, name: str, secs: float):
     """A wedged runtime hangs inside a native call no exception escapes;
     only a timer thread + hard exit gets an attribution line written."""
@@ -327,6 +388,10 @@ def main(argv=None) -> int:
     # ambient values of every key it touches, so no process-wide cleanup
     # (the old unconditional pop destroyed caller-set levers) is needed.
     for name, env, budget in todo:
+        # static preflight BEFORE the attempting marker and the watchdog:
+        # a poison-marked config consumes none of the session budget
+        if not _static_preflight(name, env, args.out):
+            continue
         append_line(args.out, {"event": "attempting", "config": name,
                                "budget_s": budget})
         dog = _arm_config_watchdog(args.out, name, budget)
