@@ -12,7 +12,13 @@ analyzer:
 
 which runs dptlint (analysis/: jaxpr collective checker + SPMD source
 lint; docs/ANALYSIS.md) on a self-provisioned CPU mesh — the CI
-``lint-distributed`` gate and the bench/elastic preflights call this."""
+``lint-distributed`` gate and the bench/elastic preflights call this —
+and the serving tier:
+
+    python -m distributedpytorch_tpu serve -c singleGPU --port 8008
+
+AOT-compiled, continuous-batching inference over HTTP (serve/,
+docs/SERVING.md) — the inference-side production workload."""
 
 import sys
 
@@ -26,6 +32,10 @@ def main() -> None:
         from distributedpytorch_tpu.analysis.cli import main as analyze_main
 
         sys.exit(analyze_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        from distributedpytorch_tpu.serve.cli import main as serve_main
+
+        sys.exit(serve_main(sys.argv[2:]))
     from distributedpytorch_tpu.cli import main as cli_main
 
     cli_main()

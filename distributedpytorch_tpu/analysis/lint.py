@@ -22,6 +22,15 @@ with rationale and what each provably excludes: docs/ANALYSIS.md):
   are exempt; ``.item()``/``block_until_ready`` are additionally flagged
   package-wide outside the sanctioned drain modules.
 
+* ``serve-hot-path`` — the same blocking-sync family (``.item()``,
+  ``block_until_ready``, ``np.asarray``/``jax.device_get``) inside the
+  serving tier's dispatch pipeline (the functions named in
+  ``SERVE_HOT_PATH_SCOPES``, serve/server.py): one sync there stalls
+  EVERY in-flight request on every replica, not just one step — the
+  continuous-batching design routes all device→host reads through the
+  completion drain (``pull``), which is the sanctioned exemption,
+  mirroring the train-side rule's mechanism.
+
 * ``use-after-donation`` — a value passed in donated position (argument
   0 of a ``*train_step``/``multi_step``/``accum_step`` call) is deleted
   device memory after the call; reading it — or an alias bound from it
@@ -43,7 +52,7 @@ import ast
 import dataclasses
 import os
 import re
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 
 from distributedpytorch_tpu.analysis import Finding
 
@@ -105,6 +114,20 @@ SANCTIONED_SYNC_MODULES = (
 )
 HOT_SYNC_CALLS = frozenset({"np.asarray", "np.array", "numpy.asarray",
                             "numpy.array", "jax.device_get", "device_get"})
+
+#: Serve-tier hot path: (path suffix, function name) of the dispatch
+#: pipeline in serve/server.py — the flush stream, the placement
+#: callback, and the dispatch loop itself. Unlike the train hot path
+#: (one step stalled), a host sync here serializes the WHOLE serving
+#: pipeline: every queued bucket on every replica waits behind it.
+SERVE_HOT_PATH_SCOPES: Tuple[Tuple[str, str], ...] = (
+    (os.path.join("serve", "server.py"), "_dispatch_loop"),
+    (os.path.join("serve", "server.py"), "_place"),
+    (os.path.join("serve", "server.py"), "_bucket_stream"),
+)
+#: The serve tier's sanctioned drain: completion workers (``pull``) are
+#: WHERE device results become host masks — blocking is their job.
+SERVE_SANCTIONED_DRAIN_FNS = frozenset({"pull"})
 
 #: Terminal names of calls that donate their first argument's buffers —
 #: the jitted step family the strategies build with donate_argnums
@@ -301,18 +324,32 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
     in_hot_file = any(rel_path.endswith(sfx) for sfx, _fn in HOT_PATH_SCOPES)
     hot_fn_names = {fn for sfx, fn in HOT_PATH_SCOPES
                     if rel_path.endswith(sfx)}
+    serve_fn_names = {fn for sfx, fn in SERVE_HOT_PATH_SCOPES
+                      if rel_path.endswith(sfx)}
     sync_sanctioned_file = any(
         rel_path.endswith(sfx) for sfx in SANCTIONED_SYNC_MODULES
     )
 
-    def hot_context(chain: List[_FnInfo]) -> bool:
-        """Inside a hot-path scope and not inside a sanctioned drain."""
-        if not in_hot_file:
+    def _scoped_context(chain: List[_FnInfo], scope_names: Set[str],
+                        drain_names: FrozenSet[str]) -> bool:
+        """Inside one of ``scope_names`` and not inside a sanctioned
+        drain — the shared mechanism of both hot-path rules."""
+        if not scope_names:
             return False
         names = [info.name for info in chain]
-        if any(n in SANCTIONED_DRAIN_FNS for n in names):
+        if any(n in drain_names for n in names):
             return False
-        return any(n in hot_fn_names for n in names)
+        return any(n in scope_names for n in names)
+
+    def hot_context(chain: List[_FnInfo]) -> bool:
+        if not in_hot_file:
+            return False
+        return _scoped_context(chain, hot_fn_names, SANCTIONED_DRAIN_FNS)
+
+    def serve_hot_context(chain: List[_FnInfo]) -> bool:
+        return _scoped_context(
+            chain, serve_fn_names, SERVE_SANCTIONED_DRAIN_FNS
+        )
 
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
@@ -360,6 +397,19 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                 f"dispatch pipeline (one device sync per step) — route "
                 f"the value through LossRecords' parked-row drain or a "
                 f"sanctioned `pull` helper",
+            )
+
+        # -- serve-hot-path: any blocking sync in the serve dispatch
+        # pipeline (flush stream / placement / dispatch loop) outside
+        # the completion drain
+        if (blocks or dotted in HOT_SYNC_CALLS) and serve_hot_context(chain):
+            emit(
+                "serve-hot-path", node,
+                f"`{dotted or term}` blocks on a device value inside the "
+                f"serve dispatch pipeline — every queued bucket on every "
+                f"replica stalls behind it; device→host reads belong in "
+                f"the completion drain (`pull`), which resolves request "
+                f"futures off the dispatch path",
             )
 
     # -- use-after-donation (per function body, EXCLUDING nested defs:

@@ -246,3 +246,68 @@ class TrainConfig:
     def method_tag(self) -> str:
         """Artifact directory tag, e.g. ./loss/<tag>/ and ./logs/<tag>.log."""
         return self.train_method
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """The serving tier's knobs (serve/, docs/SERVING.md) — what
+    ``python -m distributedpytorch_tpu serve`` parses into and what
+    ``tools/bench_serve.py`` sweeps over.
+
+    Model-identity fields (arch/widths/geometry/s2d) must match the
+    trained checkpoint, exactly like predict.py's flags — both surfaces
+    resolve them through the same ``serve/infer.load_inference_bundle``.
+    """
+
+    # -- model / checkpoint (must match training) ---------------------------
+    checkpoint: str = ""
+    checkpoint_dir: str = "./checkpoints"
+    image_size: Tuple[int, int] = (960, 640)  # (W, H), CLI flag order
+    model_arch: str = "unet"
+    model_widths: Optional[Tuple[int, ...]] = None
+    s2d_levels: int = -1
+    threshold: float = 0.5
+
+    # -- batching -----------------------------------------------------------
+    # The padded bucket ladder: every dispatch rides one of exactly these
+    # batch shapes, each AOT-compiled per replica at startup (first
+    # request pays zero compiler time). More buckets = less padding but
+    # more startup compiles.
+    bucket_sizes: Tuple[int, ...] = (1, 2, 4, 8)
+    # Latency SLO for the batching wait: a request is flushed (in the
+    # smallest covering bucket) at most this long after admission even
+    # if its bucket never fills.
+    slo_ms: float = 50.0
+    # Work-conserving dispatch: with an idle replica, flush immediately
+    # instead of waiting for the SLO — batches form exactly when
+    # capacity (not the clock) is the bottleneck. False = pure SLO
+    # batching (throughput-biased; useful for bench A/Bs).
+    eager_when_idle: bool = True
+    # Pending-image admission cap (None = 4x the largest bucket): beyond
+    # it submits are rejected ("overloaded"), so queue depth — and with
+    # it queueing latency — is bounded by construction under overload.
+    queue_cap_images: Optional[int] = None
+
+    # -- execution ----------------------------------------------------------
+    # Data-parallel replica groups over the local devices (clamped to
+    # the devices present). Serving is collective-free: N replicas serve
+    # N concurrent buckets independently.
+    replicas: int = 1
+    # Buckets stacked + H2D-placed ahead of dispatch on the placement
+    # worker (utils/prefetch.pipelined_placement); 0 = synchronous.
+    placement_depth: int = 2
+    # Dispatched-but-undrained buckets allowed per replica: the device
+    # queue keeps one bucket behind the executing one (H2D overlaps
+    # compute) but can never absorb unbounded backlog — in-flight slots
+    # return at COMPLETION, so total work-in-system stays bounded and
+    # overload surfaces as rejections instead of silent latency growth.
+    inflight_per_replica: int = 2
+    # None = one drain thread per in-flight slot (the drain pool must
+    # never be the throughput ceiling).
+    completion_workers: Optional[int] = None
+    # SampleCache budget (MiB) for path-keyed request decode; 0 = off.
+    host_cache_mb: int = 256
+
+    # -- transport ----------------------------------------------------------
+    host: str = "127.0.0.1"
+    port: int = 8008

@@ -30,7 +30,7 @@ import os
 import threading
 from os.path import splitext
 from pathlib import Path
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, Hashable, Optional, Sequence, Tuple
 
 import numpy as np
 from PIL import Image
@@ -43,6 +43,10 @@ except ImportError:  # pragma: no cover - a broken/absent native layer must
 logger = logging.getLogger(__name__)
 
 Item = Dict[str, np.ndarray]
+#: Cache keys: the train loaders key by dataset index (int); the serving
+#: tier keys by ``(path, size)`` tuples. Anything hashable works — the
+#: cache itself never interprets the key.
+Key = Hashable
 
 
 class SampleCache:
@@ -75,11 +79,15 @@ class SampleCache:
     it concurrently. Stored arrays are shared across epochs — callers
     must treat items as read-only (batch assembly np.stack-copies, so
     nothing downstream mutates them).
+
+    The serving tier (serve/engine.py) reuses this as its request-path
+    decode cache, keyed by ``(path, size)`` instead of dataset index:
+    repeat traffic over the same objects skips PIL/libjpeg entirely.
     """
 
     def __init__(self, budget_bytes: int):
         self.budget_bytes = int(budget_bytes)
-        self._items: Dict[int, Item] = {}
+        self._items: Dict[Key, Item] = {}
         self._lock = threading.Lock()
         self.used_bytes = 0
         self.hits = 0
@@ -90,7 +98,7 @@ class SampleCache:
     def _nbytes(item: Item) -> int:
         return sum(int(np.asarray(v).nbytes) for v in item.values())
 
-    def get(self, idx: int) -> Optional[Item]:
+    def get(self, idx: Key) -> Optional[Item]:
         with self._lock:
             item = self._items.get(idx)
             if item is None:
@@ -99,7 +107,7 @@ class SampleCache:
                 self.hits += 1
             return item
 
-    def put(self, idx: int, item: Item) -> bool:
+    def put(self, idx: Key, item: Item) -> bool:
         """Store if the budget allows; returns whether it was stored."""
         size = self._nbytes(item)
         with self._lock:

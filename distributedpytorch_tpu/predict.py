@@ -1,13 +1,19 @@
-"""Inference: masks from a trained checkpoint.
+"""Inference: masks from a trained checkpoint — the batch-offline CLI.
 
 The reference ships `plot_img_and_mask` (reference utils/utils.py:38-51)
 but no code path that ever produces a predicted mask to plot — inference
 is a hole in its surface. This module closes it TPU-style: ONE jitted
 batched forward reused across the run, images streamed batch-by-batch
-(memory stays O(batch_size), not O(dataset)) through the same
-preprocessing as training (BasicDataset.preprocess — BICUBIC resize,
-/255, NHWC, forced RGB), masks thresholded at 0.5 and written as {0,255}
-PNGs.
+(memory stays O(batch_size), not O(dataset)), masks thresholded at 0.5
+and written as {0,255} PNGs.
+
+Every inference-semantics piece — preprocessing (BICUBIC resize, /255,
+NHWC, forced RGB), the eval forward, checkpoint loading, mask
+thresholding — lives in ``serve/infer.py`` and is SHARED with the
+serving tier (``python -m distributedpytorch_tpu serve``): this CLI and
+the server run the same functions, and tests/test_serve.py pins their
+outputs bit-identical. This module only adds the offline concerns:
+directory walking, output naming, PNG writing.
 
 CLI:  dpt-predict -c singleGPU -i ./data/test_hq -o ./predictions
       (or: python -m distributedpytorch_tpu.predict ...)
@@ -22,6 +28,15 @@ from typing import Iterable, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from distributedpytorch_tpu.serve.infer import (
+    bundle_variables,
+    load_inference_bundle,
+    load_params_for_inference,  # noqa: F401 — re-export (historical home)
+    make_forward,
+    postprocess_mask,
+    preprocess_image,
+)
+
 logger = logging.getLogger(__name__)
 
 
@@ -35,26 +50,23 @@ def predict_batches(
     """Stream (probs (b,H,W), inputs (b,H,W,3)) pairs over an iterable of
     (H,W,3) float32 arrays. One jit compile for full batches (plus at most
     one for a ragged final batch). Stateful models (milesial BatchNorm)
-    pass their running statistics as `model_state` and apply in eval mode."""
+    pass their running statistics as `model_state` and apply in eval mode.
+
+    The forward is ``serve/infer.make_forward`` — the function the
+    serving tier AOT-compiles per bucket; here it jit-compiles lazily at
+    the offline CLI's two shapes."""
     import jax
     import jax.numpy as jnp
 
-    stateful = bool(getattr(model, "is_stateful", False))
-
-    @jax.jit
-    def forward(p, x):
-        if stateful:
-            return model.apply(
-                {"params": p, "batch_stats": model_state}, x, train=False
-            )
-        return model.apply({"params": p}, x)
+    variables = bundle_variables(model, params, model_state)
+    forward = jax.jit(make_forward(model))
 
     buf: List[np.ndarray] = []
 
     def flush(buf):
         batch = np.stack(buf)
-        preds = forward(params, jnp.asarray(batch))
-        return np.asarray(preds)[..., 0], batch
+        probs = forward(variables, jnp.asarray(batch))
+        return np.asarray(probs), batch
 
     for arr in images:
         buf.append(arr)
@@ -63,44 +75,6 @@ def predict_batches(
             buf = []
     if buf:
         yield flush(buf)
-
-
-def load_params_for_inference(checkpoint_path: str, model, input_hw: Tuple[int, int]):
-    """(params, model_state) from a native .ckpt or a reference-format .pth
-    (the format dispatch lives in checkpoint.load_weights, shared with the
-    trainer). ``model_state`` is the BatchNorm running stats for stateful
-    models, None otherwise."""
-    import jax
-    import jax.numpy as jnp
-
-    variables = model.init(
-        jax.random.key(0), jnp.zeros((1, input_hw[0], input_hw[1], 3))
-    )
-    template = variables["params"]
-    state_template = variables.get("batch_stats")
-    if checkpoint_path.endswith(".pth"):
-        if state_template is not None:
-            # stateful family: milesial/Pytorch-UNet-layout .pth (the
-            # public upstream checkpoints load directly)
-            from distributedpytorch_tpu.checkpoint import import_milesial_pth
-
-            return import_milesial_pth(checkpoint_path, template, state_template)
-        from distributedpytorch_tpu.checkpoint import load_weights
-
-        return load_weights(checkpoint_path, template), state_template
-    from distributedpytorch_tpu.checkpoint import load_checkpoint
-
-    restored = load_checkpoint(
-        checkpoint_path, template, model_state_target=state_template
-    )
-    model_state = restored["model_state"]
-    if state_template is not None and model_state is None:
-        logger.warning(
-            "checkpoint %s has no batch_stats; using init statistics",
-            checkpoint_path,
-        )
-        model_state = state_template
-    return restored["params"], model_state
 
 
 def run_prediction(
@@ -128,30 +102,17 @@ def run_prediction(
     """
     from PIL import Image
 
-    from distributedpytorch_tpu.checkpoint import resolve_checkpoint
-    from distributedpytorch_tpu.config import TrainConfig
     from distributedpytorch_tpu.data.dataset import BasicDataset
-    from distributedpytorch_tpu.models import create_model
 
-    path = resolve_checkpoint(checkpoint, checkpoint_dir)
-
-    w, h = int(image_size[0]), int(image_size[1])
-    cfg = TrainConfig(
+    bundle = load_inference_bundle(
+        checkpoint,
+        checkpoint_dir=checkpoint_dir,
+        image_size=image_size,
         model_arch=model_arch,
-        model_widths=tuple(model_widths) if model_widths else None,
+        model_widths=model_widths,
         s2d_levels=s2d_levels,
     )
-    div = 2 ** cfg.model_levels
-    if s2d_levels != 0 and (h % div or w % div):
-        import dataclasses
-
-        logger.info(
-            "image size %dx%d not divisible by %d: space-to-depth execution "
-            "unavailable, using the (equivalent) pixel path", w, h, div,
-        )
-        cfg = dataclasses.replace(cfg, s2d_levels=0)
-    model, _ = create_model(cfg)
-    params, model_state = load_params_for_inference(path, model, input_hw=(h, w))
+    w, h = int(image_size[0]), int(image_size[1])
 
     files = sorted(
         f
@@ -178,20 +139,19 @@ def run_prediction(
 
     def load_stream() -> Iterator[np.ndarray]:
         for f in files:
-            img = BasicDataset.load(os.path.join(input_dir, f))
-            # inference accepts any PIL-decodable input: palette GIFs,
-            # RGBA PNGs, grayscale — the model wants exactly 3 channels
-            img = img.convert("RGB")
-            yield BasicDataset.preprocess(img, (w, h), is_mask=False)
+            yield preprocess_image(
+                BasicDataset.load(os.path.join(input_dir, f)), (w, h)
+            )
 
     written: List[str] = []
     idx = 0
     for probs, inputs in predict_batches(
-        params, model, load_stream(), batch_size, model_state=model_state
+        bundle.params, bundle.model, load_stream(), batch_size,
+        model_state=bundle.model_state,
     ):
         for prob, inp in zip(probs, inputs):
             stem = out_stem(files[idx])
-            mask = (prob >= threshold).astype(np.uint8) * 255
+            mask = postprocess_mask(prob, threshold)
             out_path = os.path.join(output_dir, f"{stem}_mask.png")
             Image.fromarray(mask).save(out_path)
             written.append(out_path)

@@ -1,0 +1,38 @@
+"""The serving tier: AOT-compiled, continuous-batching inference.
+
+``python -m distributedpytorch_tpu serve`` — the first inference-side
+subsystem in the codebase, and the second workload the elastic
+supervisor can keep alive. Architecture (docs/SERVING.md):
+
+* ``infer.py``      — shared preprocess/forward/postprocess, used
+                      verbatim by the offline ``predict.py`` CLI (the
+                      parity test pins the two surfaces bit-identical);
+* ``bucketing.py``  — the padded-batch bucket ladder (one AOT compile
+                      per bucket per replica, at startup);
+* ``queue.py``      — the continuous-batching queue: full/deadline/
+                      eager flushes under a latency SLO, overload
+                      shedding to smaller full buckets, bounded
+                      admission with explicit rejection;
+* ``engine.py``     — per-replica AOT executables over the mesh's
+                      devices + the SampleCache-backed decode path;
+* ``server.py``     — the dispatch pipeline (pipelined_placement on
+                      the request path; completion drain owns every
+                      device→host sync — dptlint's ``serve-hot-path``
+                      rule enforces the boundary);
+* ``metrics.py``    — async per-request accounting (p50/p99, imgs/s);
+* ``cli.py``        — the stdlib HTTP surface.
+
+This module is import-light: pieces with a jax dependency import it
+lazily, so queue/bucketing tests and the jax-free supervisor can load
+the package without a backend.
+"""
+
+from distributedpytorch_tpu.serve.bucketing import BucketPlanner  # noqa: F401
+from distributedpytorch_tpu.serve.metrics import ServeMetrics  # noqa: F401
+from distributedpytorch_tpu.serve.queue import (  # noqa: F401
+    REJECT_OVERLOAD,
+    REJECT_SHUTDOWN,
+    REJECT_TOO_LARGE,
+    BatchingQueue,
+    ServeRequest,
+)

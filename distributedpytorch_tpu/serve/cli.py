@@ -1,0 +1,235 @@
+"""``python -m distributedpytorch_tpu serve``: the production serving
+entry point — HTTP over the in-process :class:`Server`.
+
+Stdlib-only transport (``http.server.ThreadingHTTPServer``): each
+connection gets a handler thread that decodes, submits, and blocks on
+the request's future — the continuous-batching queue coalesces across
+handler threads, which is exactly the concurrency shape the batching
+layer exists for. Endpoints:
+
+* ``POST /predict`` — body: one image (any PIL-decodable format) →
+  ``image/png`` mask ({0, 255}); ``503`` + JSON when shed capacity is
+  exhausted (body carries the rejection reason), ``400`` on an
+  undecodable body.
+* ``GET /healthz``  — liveness + the compiled bucket/replica inventory.
+* ``GET /stats``    — the metrics snapshot (p50/p99, imgs/s, queue
+  depth, per-bucket dispatch counts, pad ratio).
+
+Example:
+    python -m distributedpytorch_tpu serve -c singleGPU --port 8008 \\
+        --buckets 1 2 4 8 --slo-ms 50 --replicas 4
+    curl -s --data-binary @car.jpg localhost:8008/predict > mask.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import io
+import json
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+
+def get_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m distributedpytorch_tpu serve",
+        description="Serve mask predictions over HTTP with AOT-compiled "
+                    "continuous batching",
+    )
+    parser.add_argument("--checkpoint", "-c", required=True,
+                        help="Checkpoint name (e.g. singleGPU) or path "
+                             "(.ckpt/.pth)")
+    parser.add_argument("--checkpoint-dir", default="./checkpoints")
+    parser.add_argument("--image-size", type=int, nargs=2, default=(960, 640),
+                        metavar=("W", "H"))
+    parser.add_argument("--model", dest="model_arch", type=str,
+                        default="unet", choices=["unet", "milesial"],
+                        help="Model family the checkpoint was trained with")
+    parser.add_argument("--model-widths", type=int, nargs="+", default=None)
+    parser.add_argument("--s2d-levels", type=int, default=-1)
+    parser.add_argument("--threshold", "-t", type=float, default=0.5)
+    parser.add_argument("--buckets", type=int, nargs="+", default=(1, 2, 4, 8),
+                        help="Padded batch bucket ladder — one AOT compile "
+                             "per bucket per replica at startup")
+    parser.add_argument("--slo-ms", type=float, default=50.0,
+                        help="Batching latency SLO: a request waits at most "
+                             "this long for its bucket to fill")
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="Data-parallel replica groups (clamps to the "
+                             "devices present)")
+    parser.add_argument("--queue-cap", type=int, default=None,
+                        help="Pending-image hard cap (default 4x the "
+                             "largest bucket); beyond it requests are shed "
+                             "with HTTP 503")
+    parser.add_argument("--placement-depth", type=int, default=2,
+                        help="Buckets stacked+placed ahead of dispatch "
+                             "(0 = synchronous placement)")
+    parser.add_argument("--inflight-per-replica", type=int, default=2,
+                        help="Dispatched-but-undrained buckets per replica "
+                             "(bounds work-in-system under overload)")
+    parser.add_argument("--completion-workers", type=int, default=None)
+    parser.add_argument("--host-cache-mb", type=int, default=256,
+                        help="SampleCache budget for path-keyed request "
+                             "decode (0 = off)")
+    parser.add_argument("--no-eager", action="store_true",
+                        help="Disable work-conserving dispatch: wait for "
+                             "full buckets or the SLO even when replicas "
+                             "are idle (throughput-biased)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8008)
+    return parser.parse_args(argv)
+
+
+def to_config(args):
+    """argparse namespace → :class:`ServeConfig` (single source of knob
+    names between the CLI and the bench's programmatic construction)."""
+    from distributedpytorch_tpu.config import ServeConfig
+
+    return ServeConfig(
+        checkpoint=args.checkpoint,
+        checkpoint_dir=args.checkpoint_dir,
+        image_size=tuple(args.image_size),
+        model_arch=args.model_arch,
+        model_widths=tuple(args.model_widths) if args.model_widths else None,
+        s2d_levels=args.s2d_levels,
+        threshold=args.threshold,
+        bucket_sizes=tuple(args.buckets),
+        slo_ms=args.slo_ms,
+        eager_when_idle=not args.no_eager,
+        queue_cap_images=args.queue_cap,
+        replicas=args.replicas,
+        placement_depth=args.placement_depth,
+        inflight_per_replica=args.inflight_per_replica,
+        completion_workers=args.completion_workers,
+        host_cache_mb=args.host_cache_mb,
+        host=args.host,
+        port=args.port,
+    )
+
+
+def build_server(args):
+    """args → started-able :class:`Server` (engine AOT-compiles here)."""
+    from distributedpytorch_tpu.serve.server import Server
+
+    return Server.from_config(to_config(args))
+
+
+def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
+                     request_timeout_s: float = 30.0):
+    """Wrap a started :class:`Server` in a ThreadingHTTPServer (port 0 =
+    ephemeral; read the bound port off ``.server_address``)."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from PIL import Image
+
+    from distributedpytorch_tpu.serve.server import (
+        STATUS_REJECTED,
+        STATUS_SHUTDOWN,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, obj: dict) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server's contract
+            if self.path == "/healthz":
+                self._json(200, {
+                    "status": "ok",
+                    "buckets": list(server.engine.planner.sizes),
+                    "replicas": server.engine.num_replicas,
+                })
+            elif self.path == "/stats":
+                self._json(200, server.stats())
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/predict":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                img = Image.open(io.BytesIO(body))
+                img.load()
+            except Exception:  # noqa: BLE001 — undecodable body → 400
+                self._json(400, {"error": "body is not a decodable image"})
+                return
+            try:
+                response = server.submit(img).result(
+                    timeout=request_timeout_s
+                )
+            except concurrent.futures.TimeoutError:
+                # a wedged request must get an HTTP answer, not a
+                # handler traceback + dropped connection
+                self._json(504, {
+                    "status": "error",
+                    "reason": f"no result within {request_timeout_s:.0f} s",
+                })
+                return
+            if not response.ok:
+                # rejection/shutdown = "service unavailable, retry"
+                # (the reason says whether HERE or elsewhere); anything
+                # else is this server's fault
+                code = (503 if response.status
+                        in (STATUS_REJECTED, STATUS_SHUTDOWN) else 500)
+                self._json(code, {
+                    "status": response.status, "reason": response.reason,
+                })
+                return
+            buf = io.BytesIO()
+            Image.fromarray(response.masks[0]).save(buf, format="PNG")
+            data = buf.getvalue()
+            self.send_response(200)
+            self.send_header("Content-Type", "image/png")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header(
+                "X-Serve-Latency-Ms", f"{response.latency_ms:.2f}"
+            )
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, fmt, *fmt_args):  # route through logging
+            logger.debug("http: " + fmt, *fmt_args)
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    args = get_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+    server = build_server(args).start()
+    httpd = make_http_server(server, host=args.host, port=args.port)
+    host, port = httpd.server_address[:2]
+    logger.info(
+        "serving on http://%s:%d (buckets %s, slo %.0f ms, %d replica(s)) — "
+        "POST /predict, GET /healthz, GET /stats",
+        host, port, list(server.engine.planner.sizes), args.slo_ms,
+        server.engine.num_replicas,
+    )
+    threading.Thread(  # Ctrl-C must interrupt serve_forever, not a join
+        target=httpd.serve_forever, daemon=True,
+    ).start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        logger.info("shutting down (draining queue)")
+    finally:
+        httpd.shutdown()
+        server.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

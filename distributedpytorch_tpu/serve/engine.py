@@ -1,0 +1,235 @@
+"""The replica executor: AOT-compiled eval executables per bucket shape,
+replicated data-parallel over the mesh's devices.
+
+**AOT, not JIT.** ``jax.jit`` compiles on first call — a 20-40 s stall
+on TPU that would land on whichever unlucky request first rides each
+bucket shape. The serving tier instead compiles every (bucket × replica)
+executable at *startup* via the same ``.lower(...).compile()`` path the
+analyzer's ``--hlo`` tier exercises (analysis/collectives.py): inputs
+are ``ShapeDtypeStruct``s carrying a ``SingleDeviceSharding``, so each
+executable is built for — and pinned to — its replica's device, and the
+first request pays exactly zero compiler time. A compiled executable
+also *rejects* any shape it wasn't built for, which converts a bucket
+accounting bug from silent recompilation into a loud TypeError.
+
+**Replica groups.** Serving is embarrassingly data-parallel: N devices
+serve N concurrent buckets with no cross-device collective (the static
+preflight accordingly treats serve configs as non-collective). Each
+replica holds its own device-resident copy of the weights and its own
+per-bucket executables; the server round-robins flushed buckets across
+free replicas. ``replicas`` clamps to the devices actually present, so
+the same config serves a laptop CPU and an 8-chip host.
+
+**Host-side decode cache.** Path-keyed requests decode through the PR-1
+``SampleCache`` — the serving analogue of Clipper's prediction-adjacent
+caching: repeated traffic over the same objects (the common case behind
+a CDN miss storm) skips PIL/libjpeg entirely on the request path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributedpytorch_tpu.data.dataset import SampleCache
+from distributedpytorch_tpu.serve.bucketing import BucketPlanner
+from distributedpytorch_tpu.serve.infer import (
+    InferenceBundle,
+    bundle_variables,
+    make_forward,
+    postprocess_mask,
+    preprocess_image,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Replica:
+    """One device's serving state: weights resident on ``device`` and one
+    compiled executable per bucket size."""
+
+    index: int
+    device: object
+    sharding: object
+    variables: object
+    compiled: Dict[int, object]
+
+
+class ServeEngine:
+    """Build with an :class:`InferenceBundle` (checkpoint path) or raw
+    ``(model, params, model_state)`` pieces (tests / bench fresh-init)."""
+
+    def __init__(
+        self,
+        model,
+        params,
+        model_state,
+        input_hw: Tuple[int, int],
+        bucket_sizes: Sequence[int] = (1, 2, 4, 8),
+        replicas: int = 1,
+        threshold: float = 0.5,
+        host_cache_mb: int = 0,
+        channels: int = 3,
+    ):
+        import jax
+
+        self.planner = BucketPlanner(bucket_sizes)
+        self.input_hw = (int(input_hw[0]), int(input_hw[1]))
+        self.threshold = float(threshold)
+        self.channels = int(channels)
+        self.cache = (
+            SampleCache(host_cache_mb * 2**20) if host_cache_mb > 0 else None
+        )
+        self.stateful = bool(getattr(model, "is_stateful", False))
+        self._fwd = make_forward(model)
+        variables = bundle_variables(model, params, model_state)
+
+        devices = jax.devices()
+        n = max(1, min(int(replicas), len(devices)))
+        if replicas > len(devices):
+            logger.warning(
+                "requested %d replicas but only %d devices — serving with %d",
+                replicas, len(devices), n,
+            )
+        t0 = time.monotonic()
+        self.replicas: List[Replica] = [
+            self._build_replica(i, devices[i], variables) for i in range(n)
+        ]
+        logger.info(
+            "AOT-compiled %d bucket executables (%s) x %d replica(s) in "
+            "%.1f s — first-request latency pays no JIT",
+            len(self.planner.sizes), list(self.planner.sizes), n,
+            time.monotonic() - t0,
+        )
+
+    @classmethod
+    def from_bundle(cls, bundle: InferenceBundle, **kwargs) -> "ServeEngine":
+        return cls(
+            bundle.model, bundle.params, bundle.model_state,
+            input_hw=bundle.input_hw, **kwargs,
+        )
+
+    def _build_replica(self, index: int, device, variables) -> Replica:
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import SingleDeviceSharding
+
+        sharding = SingleDeviceSharding(device)
+        vars_dev = jax.device_put(variables, sharding)
+        h, w = self.input_hw
+        jitted = jax.jit(self._fwd)
+        compiled: Dict[int, object] = {}
+        for b in self.planner.sizes:
+            x_sds = jax.ShapeDtypeStruct(
+                (b, h, w, self.channels), jnp.float32, sharding=sharding
+            )
+            compiled[b] = jitted.lower(vars_dev, x_sds).compile()
+        return Replica(
+            index=index, device=device, sharding=sharding,
+            variables=vars_dev, compiled=compiled,
+        )
+
+    @property
+    def num_replicas(self) -> int:
+        return len(self.replicas)
+
+    # -- request path pieces (the server wires these together) ---------------
+    def place(self, replica: Replica, batch: np.ndarray):
+        """Host batch → replica's device. Non-blocking on async runtimes;
+        the server runs it on the placement worker (pipelined_placement)
+        so the H2D of bucket N+1 rides under bucket N's dispatch."""
+        import jax
+
+        return jax.device_put(batch, replica.sharding)
+
+    def run(self, replica: Replica, x_dev):
+        """Dispatch the bucket's compiled executable. Raises KeyError for
+        a batch shape no executable was built for — bucket accounting
+        bugs fail loudly instead of recompiling silently."""
+        return replica.compiled[x_dev.shape[0]](replica.variables, x_dev)
+
+    def infer(self, batch: np.ndarray, replica_index: int = 0) -> np.ndarray:
+        """Synchronous single-bucket inference (tests, warmup): pads to
+        the smallest covering bucket, runs, returns the REAL rows'
+        probabilities as host float32 ``(n, H, W)``."""
+        from distributedpytorch_tpu.serve.bucketing import pad_batch
+
+        n = batch.shape[0]
+        bucket = self.planner.bucket_for(n)
+        if bucket is None:
+            raise ValueError(
+                f"batch of {n} exceeds the largest bucket "
+                f"({self.planner.max_size})"
+            )
+        replica = self.replicas[replica_index]
+        x = self.place(replica, pad_batch(np.asarray(batch, np.float32), bucket))
+        return np.asarray(self.run(replica, x))[:n]
+
+    def warmup(self) -> None:
+        """Execute every (replica, bucket) once on zeros: allocator pools
+        and any lazy runtime setup warm before traffic (compiles already
+        happened at construction)."""
+        h, w = self.input_hw
+        for replica in self.replicas:
+            for b in self.planner.sizes:
+                x = self.place(
+                    replica, np.zeros((b, h, w, self.channels), np.float32)
+                )
+                np.asarray(self.run(replica, x))
+
+    # -- host-side decode (ingress; SampleCache-backed) ----------------------
+    def preprocess(self, source, cache_key=None) -> np.ndarray:
+        """One image source → a model input row ``(H, W, C) float32``.
+        ``source`` may be a ready array (validated), a PIL image, or a
+        path (decoded through the cache when one is configured —
+        ``cache_key`` defaults to the path)."""
+        h, w = self.input_hw
+        if isinstance(source, np.ndarray):
+            if source.shape != (h, w, self.channels):
+                raise ValueError(
+                    f"expected ({h}, {w}, {self.channels}) input row, got "
+                    f"{source.shape}"
+                )
+            return np.asarray(source, np.float32)
+        if isinstance(source, str):
+            key = cache_key if cache_key is not None else (source, (w, h))
+            if self.cache is not None:
+                hit = self.cache.get(key)
+                if hit is not None:
+                    return hit["image"]
+            from distributedpytorch_tpu.serve.infer import load_image
+
+            row = load_image(source, (w, h))
+            if self.cache is not None:
+                self.cache.put(key, {"image": row})
+            return row
+        # PIL image (duck-typed: anything with .convert/.resize)
+        return preprocess_image(source, (w, h))
+
+    def postprocess(self, probs: np.ndarray) -> np.ndarray:
+        return postprocess_mask(probs, self.threshold)
+
+
+def engine_from_checkpoint(
+    checkpoint: str,
+    checkpoint_dir: str = "./checkpoints",
+    image_size: Sequence[int] = (960, 640),
+    model_arch: str = "unet",
+    model_widths: Optional[Sequence[int]] = None,
+    s2d_levels: int = -1,
+    **engine_kwargs,
+) -> ServeEngine:
+    """Checkpoint name/path → a ready (AOT-compiled) engine."""
+    from distributedpytorch_tpu.serve.infer import load_inference_bundle
+
+    bundle = load_inference_bundle(
+        checkpoint, checkpoint_dir=checkpoint_dir, image_size=image_size,
+        model_arch=model_arch, model_widths=model_widths,
+        s2d_levels=s2d_levels,
+    )
+    return ServeEngine.from_bundle(bundle, **engine_kwargs)

@@ -1,0 +1,183 @@
+"""Shared inference pieces: preprocess → forward → postprocess.
+
+ONE implementation of the inference data path, used by BOTH surfaces:
+
+* the batch-offline CLI (``predict.py`` / ``dpt-predict``) — streams a
+  directory of images through it batch-by-batch;
+* the serving tier (``serve/engine.py`` / ``python -m
+  distributedpytorch_tpu serve``) — AOT-compiles the same forward per
+  padded bucket shape and runs it under the continuous-batching queue.
+
+Because both paths run these exact functions, the offline-vs-serve
+parity test (tests/test_serve.py) can pin masks *bit-identical* across
+the two surfaces — any drift in preprocessing, the forward, or the
+thresholding is a test failure, not a silent production skew.
+
+Kept import-light at module scope (numpy/PIL only); jax loads inside
+the functions that trace, mirroring predict.py's historical layout so
+``--help`` and queue-only tests never pay a backend init.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def preprocess_image(pil_img, size_wh: Sequence[int]) -> np.ndarray:
+    """One decoded PIL image → the model's input row: forced RGB, BICUBIC
+    resize to ``(W, H)``, /255, NHWC float32 — exactly the training-side
+    ``BasicDataset.preprocess`` (any divergence here would silently skew
+    every served prediction against the trained distribution)."""
+    from distributedpytorch_tpu.data.dataset import BasicDataset
+
+    # palette GIFs, RGBA PNGs, grayscale: the model wants exactly 3 channels
+    pil_img = pil_img.convert("RGB")
+    return BasicDataset.preprocess(pil_img, size_wh, is_mask=False)
+
+
+def load_image(path: str, size_wh: Sequence[int]) -> np.ndarray:
+    """Decode + preprocess one image file (PIL / .npy / .pt dispatch via
+    ``BasicDataset.load``)."""
+    from distributedpytorch_tpu.data.dataset import BasicDataset
+
+    return preprocess_image(BasicDataset.load(path), size_wh)
+
+
+def make_forward(model) -> Callable:
+    """The eval forward as a plain jittable ``fwd(variables, x) -> probs``:
+    ``variables`` is ``{"params": ...}`` (plus ``"batch_stats"`` for
+    stateful families — milesial BatchNorm — applied in eval mode),
+    ``x`` is ``(B, H, W, 3) float32``, the result ``(B, H, W) float32``
+    sigmoid probabilities (the trailing channel squeezed inside the
+    traced program). Taking the variables as an ARGUMENT (not a closure)
+    is what lets the serving engine place them per replica device and
+    AOT-compile against device-pinned ShapeDtypeStructs."""
+    stateful = bool(getattr(model, "is_stateful", False))
+
+    def fwd(variables, x):
+        if stateful:
+            probs = model.apply(variables, x, train=False)
+        else:
+            probs = model.apply(variables, x)
+        return probs[..., 0]
+
+    return fwd
+
+
+def bundle_variables(model, params, model_state=None) -> dict:
+    """The flax variables dict ``make_forward`` consumes — batch_stats
+    included exactly when the model family is stateful."""
+    if getattr(model, "is_stateful", False):
+        return {"params": params, "batch_stats": model_state}
+    return {"params": params}
+
+
+def postprocess_mask(probs: np.ndarray, threshold: float = 0.5) -> np.ndarray:
+    """Probabilities → the served artifact: ``{0, 255} uint8`` masks
+    (same shape in, channelless out). Works on a single ``(H, W)`` row or
+    a ``(B, H, W)`` batch."""
+    return (np.asarray(probs) >= threshold).astype(np.uint8) * 255
+
+
+@dataclasses.dataclass
+class InferenceBundle:
+    """Everything one checkpoint needs to serve: the model object, its
+    weights (+ BatchNorm stats for stateful families), and the resolved
+    TrainConfig whose geometry/arch fields sized the model."""
+
+    model: object
+    params: object
+    model_state: object
+    config: object
+    input_hw: Tuple[int, int]  # (H, W) — note: CLI flags order (W, H)
+
+    def forward(self) -> Callable:
+        return make_forward(self.model)
+
+    @property
+    def variables(self) -> dict:
+        return bundle_variables(self.model, self.params, self.model_state)
+
+
+def load_inference_bundle(
+    checkpoint: str,
+    checkpoint_dir: str = "./checkpoints",
+    image_size: Sequence[int] = (960, 640),
+    model_arch: str = "unet",
+    model_widths: Optional[Sequence[int]] = None,
+    s2d_levels: int = -1,
+) -> InferenceBundle:
+    """Resolve a checkpoint name/path and build the model + weights for
+    inference. ``model_arch``/``model_widths`` must match the trained
+    checkpoint's architecture. Image sizes the space-to-depth mode cannot
+    express (H or W not divisible by ``2**levels``) fall back to the
+    (equivalent) pixel path — checkpoints are identical across execution
+    modes, so this changes speed, never results."""
+    from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+
+    path = resolve_checkpoint(checkpoint, checkpoint_dir)
+    w, h = int(image_size[0]), int(image_size[1])
+    cfg = TrainConfig(
+        model_arch=model_arch,
+        model_widths=tuple(model_widths) if model_widths else None,
+        s2d_levels=s2d_levels,
+    )
+    div = 2 ** cfg.model_levels
+    if s2d_levels != 0 and (h % div or w % div):
+        logger.info(
+            "image size %dx%d not divisible by %d: space-to-depth execution "
+            "unavailable, using the (equivalent) pixel path", w, h, div,
+        )
+        cfg = dataclasses.replace(cfg, s2d_levels=0)
+    model, _ = create_model(cfg)
+    params, model_state = load_params_for_inference(path, model, input_hw=(h, w))
+    return InferenceBundle(
+        model=model, params=params, model_state=model_state, config=cfg,
+        input_hw=(h, w),
+    )
+
+
+def load_params_for_inference(checkpoint_path: str, model, input_hw: Tuple[int, int]):
+    """(params, model_state) from a native .ckpt or a reference-format .pth
+    (the format dispatch lives in checkpoint.load_weights, shared with the
+    trainer). ``model_state`` is the BatchNorm running stats for stateful
+    models, None otherwise."""
+    import jax
+    import jax.numpy as jnp
+
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((1, input_hw[0], input_hw[1], 3))
+    )
+    template = variables["params"]
+    state_template = variables.get("batch_stats")
+    if checkpoint_path.endswith(".pth"):
+        if state_template is not None:
+            # stateful family: milesial/Pytorch-UNet-layout .pth (the
+            # public upstream checkpoints load directly)
+            from distributedpytorch_tpu.checkpoint import import_milesial_pth
+
+            return import_milesial_pth(checkpoint_path, template, state_template)
+        from distributedpytorch_tpu.checkpoint import load_weights
+
+        return load_weights(checkpoint_path, template), state_template
+    from distributedpytorch_tpu.checkpoint import load_checkpoint
+
+    restored = load_checkpoint(
+        checkpoint_path, template, model_state_target=state_template
+    )
+    model_state = restored["model_state"]
+    if state_template is not None and model_state is None:
+        logger.warning(
+            "checkpoint %s has no batch_stats; using init statistics",
+            checkpoint_path,
+        )
+        model_state = state_template
+    return restored["params"], model_state

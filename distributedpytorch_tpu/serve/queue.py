@@ -1,0 +1,257 @@
+"""The continuous-batching queue: coalesce concurrent requests into
+padded bucket shapes under a latency SLO.
+
+The serving problem (Orca, OSDI '22; Clipper, NSDI '17): one request at
+a time starves the accelerator, but waiting to fill the biggest batch
+starves the *user*. This queue holds the dial between them:
+
+* **full flush** — the FIFO head fills the largest bucket → dispatch
+  immediately at full batch (the throughput regime; under sustained
+  load every dispatch rides the big bucket).
+* **deadline flush** — the oldest request's SLO deadline arrives first
+  → flush whatever is pending into the smallest covering bucket (pad
+  rows bounded by the bucket ladder, latency bounded by the SLO).
+* **eager flush** — the dispatcher reports an idle replica and the
+  queue is non-empty → dispatch immediately (work-conserving
+  continuous batching: batching never adds latency when there is spare
+  capacity; batches *form on their own* exactly when capacity is the
+  bottleneck).
+* **overload shedding** — when the backlog holds more than one full
+  bucket beyond the head group, flushes drop to the **largest bucket
+  they can completely fill** instead of padding up: under overload,
+  pad rows are pure wasted accelerator time, so padding is what gets
+  shed. Admission is capped at ``hard_cap_images`` pending rows —
+  beyond it ``submit`` returns :data:`REJECT_OVERLOAD` instead of
+  queueing, so queue depth (and therefore queueing latency) is bounded
+  by construction rather than by hope.
+
+Requests are whole units: a k-image request coalesces into one bucket
+and is never split across dispatches (its response stays one piece). A
+request larger than the biggest bucket is rejected at admission with
+:data:`REJECT_TOO_LARGE` — it could never match a compiled executable.
+
+Determinism: all policy lives in ``poll()``/``_poll_locked``, driven by
+an injectable ``clock`` — the unit tests step a fake clock and never
+touch threads. ``wait_for_work`` is the thin blocking wrapper the
+server's dispatch thread uses (condition variable, woken by ``submit``
+and by the next SLO deadline).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Callable, Deque, List, Optional, Tuple
+
+import numpy as np
+
+from distributedpytorch_tpu.serve.bucketing import BucketPlanner
+
+#: ``submit`` rejection reasons (stable strings — they surface in bench
+#: reports and HTTP 503 bodies, so clients can switch on them).
+#: ``overloaded`` means "this instance is shedding, back off and retry";
+#: ``shutdown`` means "this instance is going away, retry elsewhere" —
+#: conflating them would have clients hammering a stopping server.
+REJECT_OVERLOAD = "overloaded"
+REJECT_TOO_LARGE = "too-large"
+REJECT_SHUTDOWN = "shutdown"
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One admitted unit of work: ``images`` is a list of ``(H, W, C)``
+    float32 rows (k >= 1 of them — a request is atomic w.r.t. batching).
+    ``future`` resolves to the server's response object; the queue never
+    touches it (rejection futures resolve at the submit site)."""
+
+    images: List[np.ndarray]
+    future: object = None
+    key: str = ""
+    size: int = 0  # rows; derived from images at submit
+    enqueue_t: float = 0.0
+    deadline_t: float = 0.0
+    seq: int = 0
+
+
+class BatchingQueue:
+    """See module docstring for the flush/shed policy.
+
+    ``hard_cap_images`` defaults to 4× the largest bucket: enough to keep
+    every replica's next dispatch full under bursts, small enough that
+    worst-case queueing delay stays a handful of service times.
+    """
+
+    def __init__(
+        self,
+        planner: BucketPlanner,
+        slo_s: float = 0.05,
+        hard_cap_images: Optional[int] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.planner = planner
+        self.slo_s = float(slo_s)
+        self.hard_cap_images = int(
+            hard_cap_images if hard_cap_images is not None
+            else 4 * planner.max_size
+        )
+        if self.hard_cap_images < planner.max_size:
+            raise ValueError(
+                f"hard_cap_images={self.hard_cap_images} cannot be smaller "
+                f"than the largest bucket ({planner.max_size}) — the largest "
+                f"bucket could never fill"
+            )
+        self.clock = clock
+        self._pending: Deque[ServeRequest] = collections.deque()
+        self._pending_images = 0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._stopped = False
+        self._seq = 0
+        # observability (bench_serve samples these)
+        self.max_depth_seen = 0
+        self.submitted = 0
+        self.rejected = 0
+
+    # -- admission -----------------------------------------------------------
+    def submit(self, req: ServeRequest) -> Optional[str]:
+        """Admit a request; returns None on success or a rejection reason
+        (the caller resolves the request's future — a rejection is a
+        RESPONSE, not an exception, so load generators can count it)."""
+        req.size = len(req.images)
+        if req.size < 1:
+            raise ValueError("empty request")
+        if req.size > self.planner.max_size:
+            return REJECT_TOO_LARGE
+        with self._cond:
+            if self._stopped:
+                return REJECT_SHUTDOWN
+            if self._pending_images + req.size > self.hard_cap_images:
+                self.rejected += 1
+                return REJECT_OVERLOAD
+            now = self.clock()
+            req.enqueue_t = now
+            req.deadline_t = now + self.slo_s
+            req.seq = self._seq
+            self._seq += 1
+            self._pending.append(req)
+            self._pending_images += req.size
+            self.submitted += 1
+            self.max_depth_seen = max(self.max_depth_seen, self._pending_images)
+            self._cond.notify_all()
+        return None
+
+    # -- flush policy --------------------------------------------------------
+    def _head_group(self) -> Tuple[List[ServeRequest], int]:
+        """Longest FIFO prefix whose rows fit the largest bucket. Strictly
+        FIFO: a request that doesn't fit stops the scan (no reordering —
+        within a bucket and across buckets, completion follows submission
+        order for equal-capacity requests)."""
+        take: List[ServeRequest] = []
+        total = 0
+        for req in self._pending:
+            if total + req.size > self.planner.max_size:
+                break
+            take.append(req)
+            total += req.size
+        return take, total
+
+    def _poll_locked(self, eager: bool = False):
+        if not self._pending:
+            return None
+        now = self.clock()
+        take, total = self._head_group()
+        overloaded = self._pending_images - total >= self.planner.max_size
+        if total == self.planner.max_size or (
+            len(take) < len(self._pending) and not overloaded
+        ):
+            # head group fills (or next request overflows) the largest
+            # bucket: the throughput path
+            bucket = self.planner.bucket_for(total)
+        elif overloaded:
+            # shed: more than a full bucket is backed up behind the head
+            # group — drop to the largest bucket the head can FILL, so
+            # no dispatched row is padding while real requests wait
+            bucket = self.planner.largest_full_bucket(total)
+            trimmed: List[ServeRequest] = []
+            trimmed_total = 0
+            for req in take:
+                if trimmed_total + req.size > bucket:
+                    break
+                trimmed.append(req)
+                trimmed_total += req.size
+            if trimmed:
+                take, total = trimmed, trimmed_total
+            # an unsplittable head (single request bigger than the full
+            # bucket) keeps its covering bucket, padding and all
+            bucket = self.planner.bucket_for(total)
+        elif take[0].deadline_t <= now or eager:
+            # SLO flush / work-conserving flush: smallest covering bucket
+            bucket = self.planner.bucket_for(total)
+        else:
+            return None
+        for req in take:
+            self._pending.popleft()
+        self._pending_images -= total
+        return bucket, take
+
+    def poll(self, eager: bool = False):
+        """Non-blocking: ``(bucket_size, [requests])`` ready to dispatch,
+        or None. ``eager=True`` = the caller has idle capacity in hand and
+        will dispatch whatever it gets immediately."""
+        with self._lock:
+            return self._poll_locked(eager=eager)
+
+    def wait_for_work(self, timeout: float = 0.25, eager=False):
+        """Blocking ``poll`` for the dispatch thread: waits until a group
+        is dispatchable, the queue stops, or ``timeout`` elapses — waking
+        early for the oldest request's SLO deadline. ``eager`` may be a
+        bool or a zero-arg callable re-evaluated on every wake: capacity
+        that frees up mid-wait (a completion returning a replica slot —
+        see :meth:`kick`) must flip the work-conserving path on without
+        waiting out the rest of the SLO."""
+        eager_fn = eager if callable(eager) else (lambda: eager)
+        limit = self.clock() + timeout
+        with self._cond:
+            while not self._stopped:
+                got = self._poll_locked(eager=eager_fn())
+                if got is not None:
+                    return got
+                now = self.clock()
+                wait = limit - now
+                if self._pending:
+                    wait = min(wait, self._pending[0].deadline_t - now)
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+            return None
+
+    def kick(self) -> None:
+        """Wake ``wait_for_work`` waiters without submitting anything —
+        called when serving capacity frees (a replica slot returns) so an
+        idle-capacity eager flush happens NOW, not at the SLO deadline."""
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- lifecycle / observability ------------------------------------------
+    def stop(self) -> List[ServeRequest]:
+        """Stop admitting and wake waiters; returns the still-pending
+        requests so the server can resolve their futures (shutdown is a
+        rejection, not a hang)."""
+        with self._cond:
+            self._stopped = True
+            drained = list(self._pending)
+            self._pending.clear()
+            self._pending_images = 0
+            self._cond.notify_all()
+        return drained
+
+    @property
+    def depth_images(self) -> int:
+        with self._lock:
+            return self._pending_images
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._pending)

@@ -1,0 +1,428 @@
+"""The serve loop: queue → placement worker → replica dispatch →
+completion drain.
+
+The request path is the PR-1 training pipeline turned inference-side —
+the same three-thread overlap, with the same discipline about WHO is
+allowed to block on a device value:
+
+* **ingress** (caller threads / HTTP handlers): decode + preprocess
+  (``SampleCache``-backed), admit into the :class:`BatchingQueue`.
+  Rejections resolve the request future immediately with a status —
+  overload is an answer, not an exception.
+* **placement worker** (``utils/prefetch.pipelined_placement`` — the
+  PR-1 machinery verbatim): claims a replica in-flight SLOT, stacks +
+  pads the flushed group into its bucket shape, and ``device_put``s it
+  — all ``depth`` buckets ahead of dispatch, so bucket N+1's H2D rides
+  under bucket N's execution. Slots return at *completion* (``pull``),
+  so claiming one here doubles as backpressure: when every slot is
+  taken, the placement worker blocks, the queue coalesces toward fuller
+  buckets, and total work-in-system stays bounded — overload surfaces
+  as admission rejections, never as a silently growing device queue.
+* **dispatch loop** (``_dispatch_loop``): pops placed buckets and fires
+  the replica's AOT executable. It NEVER blocks on a device value — no
+  ``np.asarray``, no ``.item()``, no ``block_until_ready`` (dptlint's
+  ``serve-hot-path`` rule enforces exactly this scope; ``pull`` is the
+  sanctioned drain).
+* **completion workers** (``pull``): block on the device result, slice
+  off pad rows, split per request, threshold to masks, resolve futures,
+  stamp metrics. Per-request accounting lives entirely here — the
+  dispatch loop stays sync-free.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import logging
+import queue as queue_mod
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from distributedpytorch_tpu.serve.bucketing import stack_group
+from distributedpytorch_tpu.serve.engine import Replica, ServeEngine
+from distributedpytorch_tpu.serve.metrics import ServeMetrics
+from distributedpytorch_tpu.serve.queue import (
+    REJECT_SHUTDOWN,
+    BatchingQueue,
+    ServeRequest,
+)
+from distributedpytorch_tpu.utils.prefetch import SINGLE, pipelined_placement
+
+logger = logging.getLogger(__name__)
+
+STATUS_OK = "ok"
+STATUS_REJECTED = "rejected"
+STATUS_ERROR = "error"
+STATUS_SHUTDOWN = "shutdown"
+
+#: _place's "this group already failed and was resolved" marker: the
+#: dispatch loop skips it and keeps serving (None means "stopping" and
+#: ends the loop — a single bad batch must not take the server down).
+_PLACE_FAILED = object()
+
+
+@dataclasses.dataclass
+class ServeResponse:
+    """What a request's future resolves to. ``masks`` is one
+    ``(H, W) uint8 {0, 255}`` array per submitted image (None unless
+    status == "ok")."""
+
+    key: str
+    status: str
+    reason: str = ""
+    masks: Optional[List[np.ndarray]] = None
+    latency_ms: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return self.status == STATUS_OK
+
+
+def pull(server: "Server", replica: Replica, out, bucket: int,
+         reqs: List[ServeRequest], dispatch_t: float) -> None:
+    """Completion drain (sanctioned sync point): block on the device
+    result, fan masks back out to request futures, record metrics — and
+    only THEN return the replica's in-flight slot. Freeing the slot at
+    completion (not at dispatch) is what bounds work-in-system: on an
+    async runtime a dispatch returns immediately, and a slot freed there
+    would let the device execution queue absorb unbounded backlog that
+    the admission cap never sees — overload latency would grow without
+    a single rejection."""
+    try:
+        probs = np.asarray(out)  # device→host; blocks until compute done
+        done_t = server.clock()
+        row = 0
+        for req in reqs:
+            masks = [
+                server.engine.postprocess(probs[row + i])
+                for i in range(req.size)
+            ]
+            row += req.size
+            server.metrics.record_request(
+                req.size, req.enqueue_t, dispatch_t, done_t
+            )
+            req.future.set_result(ServeResponse(
+                key=req.key, status=STATUS_OK, masks=masks,
+                latency_ms=(done_t - req.enqueue_t) * 1e3,
+            ))
+    except Exception as exc:  # noqa: BLE001 — a drain failure must fail
+        logger.exception("completion drain failed for bucket %d", bucket)
+        for req in reqs:  # the requests, never hang their futures
+            if not req.future.done():
+                server.metrics.record_failure()
+                req.future.set_result(ServeResponse(
+                    key=req.key, status=STATUS_ERROR, reason=str(exc),
+                ))
+    finally:
+        server._free.put(replica)
+        # capacity just freed: wake the queue so an eager flush happens
+        # now instead of at the next waiter timeout / SLO deadline
+        server.queue.kick()
+
+
+class Server:
+    """In-process serving core. The HTTP layer (serve/cli.py) and the
+    load generator (tools/bench_serve.py) both drive exactly this
+    object, so what the bench measures is what production runs."""
+
+    def __init__(
+        self,
+        engine: ServeEngine,
+        slo_ms: float = 50.0,
+        hard_cap_images: Optional[int] = None,
+        placement_depth: int = 2,
+        completion_workers: Optional[int] = None,
+        eager_when_idle: bool = True,
+        inflight_per_replica: int = 2,
+        clock=time.monotonic,
+    ):
+        self.engine = engine
+        self.clock = clock
+        self.metrics = ServeMetrics(clock=clock)
+        self.queue = BatchingQueue(
+            engine.planner, slo_s=slo_ms / 1e3,
+            hard_cap_images=hard_cap_images, clock=clock,
+        )
+        self.placement_depth = int(placement_depth)
+        self.eager_when_idle = bool(eager_when_idle)
+        # The in-flight slot pool: each replica appears
+        # ``inflight_per_replica`` times, a slot is claimed at placement
+        # and returned at COMPLETION (see ``pull``). 2 slots/replica =
+        # one bucket executing + one queued behind it on the device, so
+        # H2D and compute overlap without the device queue becoming an
+        # unbounded latency buffer.
+        self._free: queue_mod.Queue = queue_mod.Queue()
+        for _slot in range(max(1, int(inflight_per_replica))):
+            for replica in engine.replicas:
+                self._free.put(replica)
+        # all-slots-free is the drain test for "nothing in flight":
+        # slots return at completion, AFTER futures resolve
+        self._total_slots = self._free.qsize()
+        if completion_workers is None:
+            # every in-flight slot must be drainable concurrently, or the
+            # drain pool (not the devices) becomes the throughput ceiling
+            completion_workers = len(engine.replicas) * max(
+                1, int(inflight_per_replica)
+            )
+        self._completion = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(1, completion_workers),
+            thread_name_prefix="dpt-serve-pull",
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._dispatch_error: Optional[BaseException] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "Server":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        self._thread = threading.Thread(
+            target=self._dispatch_loop, name="dpt-serve-dispatch", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop serving. ``drain=True`` first waits for the queue to
+        empty and in-flight buckets to complete; still-pending requests
+        after the deadline (or with ``drain=False``) resolve with a
+        ``shutdown`` status — a stopping server never hangs a client."""
+        if drain:
+            # wall-clock on purpose (NOT self.clock): the drain advances
+            # by real sleeps, so an injected fake clock would never reach
+            # a deadline computed from itself. Draining means BOTH the
+            # queue is empty AND every in-flight slot has returned — a
+            # group already flushed into the placement pipeline is out
+            # of the queue but not yet served, and cutting it off at
+            # depth==0 would shutdown-resolve work the drain budget was
+            # there to finish.
+            limit = time.monotonic() + timeout
+            while (time.monotonic() < limit
+                   and self._dispatch_error is None
+                   and (self.queue.depth_images > 0
+                        or self._free.qsize() < self._total_slots)):
+                time.sleep(0.01)
+        self._stop.set()
+        for req in self.queue.stop():
+            if not req.future.done():
+                req.future.set_result(ServeResponse(
+                    key=req.key, status=STATUS_SHUTDOWN, reason="shutdown",
+                ))
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+        self._completion.shutdown(wait=True)
+
+    # -- ingress -------------------------------------------------------------
+    def submit(self, images, key: str = "") -> "concurrent.futures.Future":
+        """Admit one request. ``images``: a single ``(H, W, C)`` row, a
+        ``(k, H, W, C)`` stack, a list of rows, or a list of path
+        strings / PIL images (decoded through the engine's cache). The
+        future ALWAYS resolves to a :class:`ServeResponse` — rejection
+        and shutdown included."""
+        future: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            rows = self._as_rows(images)
+        except Exception as exc:  # noqa: BLE001 — bad input is a response
+            self.metrics.record_failure()
+            future.set_result(ServeResponse(
+                key=key, status=STATUS_ERROR, reason=str(exc),
+            ))
+            return future
+        req = ServeRequest(images=rows, future=future, key=key)
+        reason = self.queue.submit(req)
+        if reason is not None:
+            self.metrics.record_rejection(reason)
+            # a stopping server answers "shutdown" (retry elsewhere),
+            # not "overloaded" (back off and retry HERE)
+            status = (STATUS_SHUTDOWN if reason == REJECT_SHUTDOWN
+                      else STATUS_REJECTED)
+            future.set_result(ServeResponse(
+                key=key, status=status, reason=reason,
+            ))
+        return future
+
+    def _as_rows(self, images) -> List[np.ndarray]:
+        if isinstance(images, np.ndarray):
+            if images.ndim == 3:
+                return [self.engine.preprocess(images)]
+            if images.ndim == 4:
+                return [self.engine.preprocess(row) for row in images]
+            raise ValueError(f"expected 3- or 4-d array, got {images.shape}")
+        if isinstance(images, (list, tuple)):
+            return [self.engine.preprocess(src) for src in images]
+        return [self.engine.preprocess(images)]  # path / PIL image
+
+    # -- the serve pipeline --------------------------------------------------
+    def _bucket_stream(self):
+        """Flushed groups as prefetch work items. ``eager`` tracks free
+        capacity: with an idle replica, batching must never add latency
+        (work-conserving); with all replicas busy, the queue keeps
+        coalescing toward fuller buckets. The flag is a callable so a
+        slot freed MID-wait (``pull`` kicks the queue) flips eager on
+        immediately instead of the request waiting out its SLO."""
+
+        def eager() -> bool:
+            return self.eager_when_idle and not self._free.empty()
+
+        while not self._stop.is_set():
+            got = self.queue.wait_for_work(timeout=0.25, eager=eager)
+            if got is not None:
+                yield (SINGLE, got)
+
+    def _place(self, kind: str, payload):
+        """Placement worker: claim a replica (backpressure), stack + pad
+        to the bucket shape, H2D onto the replica's device."""
+        bucket, reqs = payload
+        replica = self._claim_replica()
+        if replica is None:  # stopping — these were already popped from
+            # the queue, so queue.stop() will never see them: resolve
+            # here or their futures hang forever
+            for req in reqs:
+                if not req.future.done():
+                    req.future.set_result(ServeResponse(
+                        key=req.key, status=STATUS_SHUTDOWN,
+                        reason="shutdown",
+                    ))
+            return None
+        try:
+            rows = [row for req in reqs for row in req.images]
+            batch = stack_group(rows, bucket)
+            return replica, self.engine.place(replica, batch), bucket, reqs
+        except BaseException as exc:  # noqa: BLE001 — contain to the group:
+            # resolve ITS futures and return the claimed slot; letting
+            # this propagate through the prefetch worker would kill the
+            # loop with the group's futures unresolved and the slot lost
+            logger.exception("placement failed for bucket %d", bucket)
+            self._free.put(replica)
+            self.queue.kick()
+            for req in reqs:
+                if not req.future.done():
+                    self.metrics.record_failure()
+                    req.future.set_result(ServeResponse(
+                        key=req.key, status=STATUS_ERROR, reason=str(exc),
+                    ))
+            return _PLACE_FAILED
+
+    def _claim_replica(self) -> Optional[Replica]:
+        while not self._stop.is_set():
+            try:
+                return self._free.get(timeout=0.1)
+            except queue_mod.Empty:
+                continue
+        return None
+
+    def _dispatch_loop(self) -> None:
+        stream = pipelined_placement(
+            self._bucket_stream(), self._place,
+            depth=self.placement_depth, name="dpt-serve-place",
+        )
+        try:
+            for _item, placed in stream:
+                if placed is None:
+                    break
+                if placed is _PLACE_FAILED:  # group already resolved
+                    continue
+                replica, x_dev, bucket, reqs = placed
+                try:
+                    dispatch_t = self.clock()
+                    out = self.engine.run(replica, x_dev)
+                    self.metrics.record_dispatch(
+                        bucket, sum(req.size for req in reqs)
+                    )
+                    self._completion.submit(
+                        pull, self, replica, out, bucket, reqs, dispatch_t
+                    )
+                except BaseException:
+                    # the group in hand would otherwise die with the
+                    # loop, its futures unresolved (queue.stop() below
+                    # can't see it — it left the queue at flush time)
+                    self._free.put(replica)
+                    for req in reqs:
+                        if not req.future.done():
+                            self.metrics.record_failure()
+                            req.future.set_result(ServeResponse(
+                                key=req.key, status=STATUS_ERROR,
+                                reason="dispatch failed",
+                            ))
+                    raise
+        except BaseException as exc:  # noqa: BLE001 — fail pending futures
+            self._dispatch_error = exc
+            logger.exception("serve dispatch loop died")
+            self._stop.set()  # ends _bucket_stream → the drain below is finite
+            for req in self.queue.stop():
+                if not req.future.done():
+                    req.future.set_result(ServeResponse(
+                        key=req.key, status=STATUS_ERROR, reason=str(exc),
+                    ))
+        finally:
+            # Groups flushed from the queue but still buffered in the
+            # placement pipeline when the loop exits would otherwise
+            # vanish with their futures unresolved (queue.stop() never
+            # sees them — they were already popped). Every exit path has
+            # _stop set (break only follows a stop-time placement miss;
+            # normal exhaustion means _bucket_stream already returned),
+            # so the stream is finite: drain it and resolve stragglers.
+            exc = self._dispatch_error
+            status = STATUS_ERROR if exc is not None else STATUS_SHUTDOWN
+            reason = str(exc) if exc is not None else "shutdown"
+            for _item, placed in stream:
+                if placed is None or placed is _PLACE_FAILED:
+                    continue
+                replica, _x_dev, _bucket, reqs = placed
+                self._free.put(replica)
+                for req in reqs:
+                    if not req.future.done():
+                        req.future.set_result(ServeResponse(
+                            key=req.key, status=status, reason=reason,
+                        ))
+
+    # -- factory -------------------------------------------------------------
+    @classmethod
+    def from_config(cls, cfg, engine: Optional[ServeEngine] = None,
+                    **overrides) -> "Server":
+        """Build from a :class:`~distributedpytorch_tpu.config.ServeConfig`.
+        Pass ``engine`` to reuse one already compiled (bench sweeps reuse
+        a single engine across server configurations); otherwise the
+        checkpoint fields drive ``engine_from_checkpoint``."""
+        if engine is None:
+            from distributedpytorch_tpu.serve.engine import (
+                engine_from_checkpoint,
+            )
+
+            engine = engine_from_checkpoint(
+                cfg.checkpoint,
+                checkpoint_dir=cfg.checkpoint_dir,
+                image_size=cfg.image_size,
+                model_arch=cfg.model_arch,
+                model_widths=cfg.model_widths,
+                s2d_levels=cfg.s2d_levels,
+                bucket_sizes=cfg.bucket_sizes,
+                replicas=cfg.replicas,
+                threshold=cfg.threshold,
+                host_cache_mb=cfg.host_cache_mb,
+            )
+        kwargs = dict(
+            slo_ms=cfg.slo_ms,
+            hard_cap_images=cfg.queue_cap_images,
+            placement_depth=cfg.placement_depth,
+            completion_workers=cfg.completion_workers,
+            eager_when_idle=cfg.eager_when_idle,
+            inflight_per_replica=cfg.inflight_per_replica,
+        )
+        kwargs.update(overrides)
+        return cls(engine, **kwargs)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap.update({
+            "queue_depth_images": self.queue.depth_images,
+            "queue_max_depth_images": self.queue.max_depth_seen,
+            "queue_hard_cap_images": self.queue.hard_cap_images,
+            "replicas": self.engine.num_replicas,
+            "buckets": list(self.engine.planner.sizes),
+        })
+        return snap

@@ -24,6 +24,11 @@ host→device placement run on the prefetch worker, ``depth`` items ahead of
 the consuming step loop. That is what keeps the device dispatch queue
 non-empty: batch N+1's H2D transfer rides under batch N's executing scan
 instead of serializing behind it.
+
+The serving tier (serve/server.py) runs the SAME scheduler on its
+request path: flushed request buckets are the work items, and
+``place_fn`` stacks + pads + H2D-places each bucket onto its claimed
+replica's device, ``depth`` buckets ahead of the dispatch loop.
 """
 
 from __future__ import annotations
@@ -48,7 +53,8 @@ _DONE = object()
 
 
 def bounded_prefetch(
-    items: Iterable[T], fn: Callable[[T], R], depth: int = 2
+    items: Iterable[T], fn: Callable[[T], R], depth: int = 2,
+    name: str = "dpt-prefetch",
 ) -> Iterator[Tuple[T, R]]:
     """Yield ``(item, fn(item))`` with ``fn`` running up to ``depth`` items
     ahead on a daemon thread.
@@ -80,7 +86,7 @@ def bounded_prefetch(
             return
         q.put(_DONE)
 
-    threading.Thread(target=worker, daemon=True, name="dpt-prefetch").start()
+    threading.Thread(target=worker, daemon=True, name=name).start()
     try:
         while True:
             payload = q.get()
@@ -148,6 +154,7 @@ def pipelined_placement(
     epoch: Optional[int] = None,
     max_retries: int = 0,
     retry_backoff_s: float = 0.05,
+    name: str = "dpt-prefetch",
 ) -> Iterator[Tuple[Tuple[str, object], object]]:
     """Yield ``(work_item, placed)`` with stacking + H2D placement running
     up to ``depth`` items ahead on the prefetch worker.
@@ -194,7 +201,7 @@ def pipelined_placement(
 
     if depth <= 0:
         return ((item, place(item)) for item in work)
-    return bounded_prefetch(work, place, depth=depth)
+    return bounded_prefetch(work, place, depth=depth, name=name)
 
 
 def bounded_submit(

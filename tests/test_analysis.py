@@ -10,6 +10,7 @@ the clean tree must pass every rule for every strategy × schedule combo.
 """
 
 import json
+import os
 import time
 
 import jax
@@ -422,6 +423,110 @@ class TestLintRules:
         out = dedupe([f, f, f])
         assert len(out) == 1 and out[0].count == 3
         assert "[x3]" in out[0].line
+
+
+# ---------------------------------------------------------------------------
+class TestServeHotPathRule:
+    """The serve-tier twin of host-sync-hot-path (ISSUE 6): blocking
+    host syncs inside the serve dispatch pipeline (serve/server.py's
+    ``_bucket_stream``/``_place``/``_dispatch_loop``) stall every
+    in-flight request on every replica; the completion drain (``pull``)
+    is the sanctioned exemption, mirroring the train rule's mechanism."""
+
+    SERVE_PATH = "distributedpytorch_tpu/serve/server.py"
+
+    def test_sync_in_dispatch_loop_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "class Server:\n"
+            "    def _dispatch_loop(self):\n"
+            "        for item in self.stream:\n"
+            "            out = self.engine.run(item)\n"
+            "            return np.asarray(out)\n"
+        )
+        findings = lint.lint_source(src, self.SERVE_PATH)
+        assert [f.rule for f in findings] == ["serve-hot-path"]
+        assert findings[0].where.endswith(":6")
+
+    def test_item_and_block_until_ready_flagged_in_serve_scope(self):
+        src = (
+            "def _place(self, kind, payload):\n"
+            "    x = self.engine.place(payload)\n"
+            "    x.block_until_ready()\n"
+            "    return x.item()\n"
+        )
+        rules = [f.rule for f in lint.lint_source(src, self.SERVE_PATH)]
+        # both calls also trip the package-wide blocking rule — the
+        # serve rule must ADD its scope-specific findings, not replace it
+        assert rules.count("serve-hot-path") == 2
+        assert rules.count("host-sync-hot-path") == 2
+
+    def test_pull_is_the_sanctioned_drain(self):
+        # the real architecture: np.asarray lives in the completion
+        # drain — both as a module-level fn and nested inside the loop
+        for src in (
+            "import numpy as np\n"
+            "def pull(server, out):\n"
+            "    return np.asarray(out)\n",
+            "import numpy as np\n"
+            "class Server:\n"
+            "    def _dispatch_loop(self):\n"
+            "        def pull(out):\n"
+            "            return np.asarray(out)\n"
+            "        return pull\n",
+        ):
+            assert [
+                f for f in lint.lint_source(src, self.SERVE_PATH)
+                if f.rule == "serve-hot-path"
+            ] == [], src
+
+    def test_scope_is_serve_server_only(self):
+        # same source outside serve/server.py (or outside the scoped
+        # functions inside it): the serve rule stays silent
+        src = (
+            "import numpy as np\n"
+            "class Server:\n"
+            "    def _dispatch_loop(self):\n"
+            "        return np.asarray(self.out)\n"
+        )
+        assert [
+            f for f in lint.lint_source(
+                src, "distributedpytorch_tpu/serve/engine.py")
+            if f.rule == "serve-hot-path"
+        ] == []
+        ingress = (
+            "import numpy as np\n"
+            "class Server:\n"
+            "    def submit(self, images):\n"
+            "        return np.asarray(images)\n"  # ingress may block
+        )
+        assert [
+            f for f in lint.lint_source(ingress, self.SERVE_PATH)
+            if f.rule == "serve-hot-path"
+        ] == []
+
+    def test_inline_suppression(self):
+        src = (
+            "import numpy as np\n"
+            "class Server:\n"
+            "    def _dispatch_loop(self):\n"
+            "        return np.asarray(self.out)  "
+            "# dptlint: disable=serve-hot-path — drained at shutdown\n"
+        )
+        assert [
+            f for f in lint.lint_source(src, self.SERVE_PATH)
+            if f.rule == "serve-hot-path"
+        ] == []
+
+    def test_shipped_server_module_is_clean(self):
+        import distributedpytorch_tpu.serve.server as server_mod
+
+        path = server_mod.__file__
+        findings = lint.lint_file(
+            path, root=os.path.dirname(
+                os.path.dirname(os.path.dirname(path)))
+        )
+        assert findings == [], findings
 
 
 # ---------------------------------------------------------------------------

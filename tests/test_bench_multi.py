@@ -424,6 +424,49 @@ class TestStaticPreflight:
         assert bench_multi.load_state(out) == {"a": "ok"}
 
 
+class TestServeBenchConfig:
+    """The serving-tier load generator as a bench_multi config (ISSUE 6):
+    registered, dispatched to tools/bench_serve.py in-process, and —
+    being collective-free single-replica data parallelism — SKIPPED by
+    the static preflight rather than blocked on a vacuous check."""
+
+    def test_registered_with_budget(self):
+        rows = [(n, e, b) for n, e, b in bench_multi.CONFIGS
+                if e.get("BENCH_SERVE") == "1"]
+        assert len(rows) == 1
+        name, _env, budget = rows[0]
+        assert name == "serve_bench"
+        assert budget >= 300.0  # per-bucket×replica AOT compiles + legs
+
+    def test_preflight_treats_serve_as_non_collective(self):
+        assert bench_multi._preflight_combos({"BENCH_SERVE": "1"}) == ()
+
+    def test_preflight_skips_without_invoking_analyzer(
+            self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("serve_bench", {"BENCH_SERVE": "1"}, 600.0)]
+        mod = TestMainLoop._fake_bench(None, [])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+
+        def never(*a):
+            raise AssertionError("preflight ran for the collective-free "
+                                 "serve bench")
+
+        monkeypatch.setattr(bench_multi, "_run_analyze", never)
+        import tools.bench_serve as bench_serve
+
+        calls = []
+
+        def fake_run_bench(budget_s=0.0, **kwargs):
+            calls.append(budget_s)
+            return {"metric": "serve_bench", "value": 42.0, "levels": []}
+
+        monkeypatch.setattr(bench_serve, "run_bench", fake_run_bench)
+        assert bench_multi.main(["--out", out]) == 0
+        assert calls == [600.0]  # dispatched in-process with its budget
+        assert bench_multi.load_state(out) == {"serve_bench": "ok"}
+
+
 class TestSupervisorRestarts:
     """Window reports carry the elastic supervisor's restart count, so a
     flapping chip window (job survived via relaunches) reads differently

@@ -1,0 +1,412 @@
+"""The serving tier end to end on the CPU backend: AOT bucket
+executables, offline-predict ↔ serve bit-parity, overload behavior,
+multi-replica dispatch, the SampleCache request path, the HTTP surface,
+and the load generator's report shape."""
+
+import http.client
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributedpytorch_tpu.config import TrainConfig
+from distributedpytorch_tpu.predict import run_prediction
+from distributedpytorch_tpu.train import Trainer
+
+SIZE_WH = (48, 32)  # (W, H) CLI order → input_hw (32, 48)
+WIDTHS = (8, 16)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """A tiny trained checkpoint + a few disk images (same rig as
+    test_predict.py, shared across every test in this module)."""
+    tmp = tmp_path_factory.mktemp("serve")
+    cfg = TrainConfig(
+        train_method="singleGPU",
+        epochs=1,
+        batch_size=8,
+        val_percent=25.0,
+        compute_dtype="float32",
+        image_size=SIZE_WH,
+        model_widths=WIDTHS,
+        synthetic_samples=16,
+        checkpoint_dir=str(tmp / "checkpoints"),
+        log_dir=str(tmp / "logs"),
+        loss_dir=str(tmp / "loss"),
+        num_workers=0,
+    )
+    Trainer(cfg).train()
+    from distributedpytorch_tpu.data import write_synthetic_carvana_tree
+
+    images_dir, _ = write_synthetic_carvana_tree(
+        str(tmp / "data"), n=4, size_wh=SIZE_WH
+    )
+    return tmp, images_dir
+
+
+@pytest.fixture(scope="module")
+def engine(trained):
+    """One AOT-compiled engine shared by the module (compiles are the
+    expensive part; servers are cheap and built per test)."""
+    tmp, _ = trained
+    from distributedpytorch_tpu.serve.engine import engine_from_checkpoint
+
+    return engine_from_checkpoint(
+        "singleGPU",
+        checkpoint_dir=str(tmp / "checkpoints"),
+        image_size=SIZE_WH,
+        model_widths=WIDTHS,
+        bucket_sizes=(1, 2, 4),
+        replicas=1,
+        host_cache_mb=16,
+    )
+
+
+def _image_files(images_dir):
+    return sorted(
+        os.path.join(images_dir, f) for f in os.listdir(images_dir)
+        if not f.startswith(".")
+    )
+
+
+def _predict_masks(trained, batch_size):
+    """Offline predict.py masks, read back from its PNG artifacts."""
+    tmp, images_dir = trained
+    out = tmp / f"predict_b{batch_size}"
+    written = run_prediction(
+        "singleGPU", images_dir, str(out),
+        image_size=SIZE_WH, batch_size=batch_size,
+        checkpoint_dir=str(tmp / "checkpoints"), model_widths=WIDTHS,
+    )
+    return [np.asarray(Image.open(p)) for p in written]
+
+
+class TestEngine:
+    def test_aot_compiles_every_bucket_at_startup(self, engine):
+        for replica in engine.replicas:
+            assert sorted(replica.compiled) == [1, 2, 4]
+
+    def test_oversized_batch_is_refused(self, engine):
+        with pytest.raises(ValueError, match="largest bucket"):
+            engine.infer(np.zeros((5, 32, 48, 3), np.float32))
+
+    def test_infer_matches_jit_forward_bitwise(self, trained, engine):
+        """The AOT executable and predict.py's lazily-jitted forward
+        lower the same program at the same shape — bit-identical."""
+        from distributedpytorch_tpu.predict import predict_batches
+        from distributedpytorch_tpu.serve.infer import load_inference_bundle
+
+        tmp, images_dir = trained
+        bundle = load_inference_bundle(
+            "singleGPU", checkpoint_dir=str(tmp / "checkpoints"),
+            image_size=SIZE_WH, model_widths=WIDTHS,
+        )
+        rng = np.random.default_rng(0)
+        batch = rng.random((4, 32, 48, 3), np.float32)
+        (jit_probs, _inputs), = predict_batches(
+            bundle.params, bundle.model, list(batch), batch_size=4,
+            model_state=bundle.model_state,
+        )
+        aot_probs = engine.infer(batch)
+        np.testing.assert_array_equal(jit_probs, aot_probs)
+
+    def test_padded_rows_do_not_perturb_real_rows(self, engine):
+        """Eval forwards are per-sample: a 3-row batch padded into the
+        4-bucket must give each real row the same mask as any other
+        dispatch shape containing it."""
+        rng = np.random.default_rng(1)
+        batch = rng.random((3, 32, 48, 3), np.float32)
+        padded = engine.postprocess(engine.infer(batch))  # rides bucket 4
+        for i in range(3):
+            solo = engine.postprocess(engine.infer(batch[i:i + 1]))[0]
+            np.testing.assert_array_equal(padded[i], solo)
+
+    def test_preprocess_uses_sample_cache(self, trained, engine):
+        _tmp, images_dir = trained
+        path = _image_files(images_dir)[0]
+        before = engine.cache.hits
+        a = engine.preprocess(path)
+        b = engine.preprocess(path)
+        assert engine.cache.hits > before
+        np.testing.assert_array_equal(a, b)
+
+
+class TestServeParity:
+    """The regression pin: offline predict.py masks are bit-identical to
+    serve-path responses for the same checkpoint and inputs."""
+
+    def _serve(self, engine, **kwargs):
+        from distributedpytorch_tpu.serve.server import Server
+
+        return Server(engine, **kwargs).start()
+
+    def test_one_request_bit_identical_to_offline_batch(
+            self, trained, engine):
+        # all 4 files as ONE request → one bucket-4 dispatch — the same
+        # batch shape offline predict.py runs at batch_size=4
+        _tmp, images_dir = trained
+        offline = _predict_masks(trained, batch_size=4)
+        server = self._serve(engine)
+        try:
+            response = server.submit(_image_files(images_dir)).result(60)
+            assert response.ok
+            assert len(response.masks) == 4
+            for served, ref in zip(response.masks, offline):
+                np.testing.assert_array_equal(served, ref)
+                assert served.dtype == np.uint8
+                assert set(np.unique(served)) <= {0, 255}
+        finally:
+            server.stop()
+
+    def test_singles_bit_identical_across_bucket_shapes(
+            self, trained, engine):
+        # per-image requests ride other executables (bucket 1) than
+        # offline batch_size=4 — masks must still match exactly
+        _tmp, images_dir = trained
+        offline = _predict_masks(trained, batch_size=4)
+        server = self._serve(engine)
+        try:
+            futures = [server.submit(p) for p in _image_files(images_dir)]
+            for fut, ref in zip(futures, offline):
+                response = fut.result(60)
+                assert response.ok
+                np.testing.assert_array_equal(response.masks[0], ref)
+        finally:
+            server.stop()
+
+
+class TestServerBehavior:
+    def _serve(self, engine, **kwargs):
+        from distributedpytorch_tpu.serve.server import Server
+
+        return Server(engine, **kwargs).start()
+
+    def test_overload_sheds_with_status_and_bounded_depth(self, engine):
+        server = self._serve(
+            engine, hard_cap_images=4, slo_ms=200.0,
+            eager_when_idle=False, placement_depth=0,
+        )
+        try:
+            rng = np.random.default_rng(2)
+            img = rng.random((32, 48, 3), np.float32)
+            futures = [server.submit(img, key=str(i)) for i in range(64)]
+            responses = [f.result(60) for f in futures]
+            statuses = {r.status for r in responses}
+            rejected = [r for r in responses if r.status == "rejected"]
+            assert rejected, statuses
+            assert all(r.reason == "overloaded" for r in rejected)
+            assert any(r.ok for r in responses)
+            assert server.queue.max_depth_seen <= 4
+        finally:
+            server.stop()
+
+    def test_multi_replica_serves_all(self, trained):
+        tmp, _ = trained
+        from distributedpytorch_tpu.serve.engine import engine_from_checkpoint
+
+        eng2 = engine_from_checkpoint(
+            "singleGPU", checkpoint_dir=str(tmp / "checkpoints"),
+            image_size=SIZE_WH, model_widths=WIDTHS,
+            bucket_sizes=(1, 2), replicas=2,
+        )
+        assert eng2.num_replicas == 2
+        # replica groups really are distinct devices, not one device twice
+        assert len({r.device for r in eng2.replicas}) == 2
+        server = self._serve(eng2)
+        try:
+            rng = np.random.default_rng(3)
+            futures = [
+                server.submit(rng.random((32, 48, 3), np.float32))
+                for _ in range(8)
+            ]
+            assert all(f.result(60).ok for f in futures)
+        finally:
+            server.stop()
+
+    def test_shutdown_resolves_pending_futures(self, engine):
+        server = self._serve(engine)
+        server.stop(drain=True)
+        # post-stop submissions resolve immediately — as SHUTDOWN
+        # ("retry elsewhere"), not overloaded ("back off and retry here")
+        response = server.submit(
+            np.zeros((32, 48, 3), np.float32)
+        ).result(5)
+        assert response.status == "shutdown"
+
+    def test_no_drain_stop_never_hangs_a_flushed_request(self, engine):
+        """A group flushed from the queue but still waiting for a
+        replica slot when stop() fires was popped from the queue — so
+        queue.stop() can't resolve it. The placement path must: every
+        submitted future resolves, drain or no drain."""
+
+        class SlowRun:
+            """Engine proxy whose first run() blocks until released —
+            wedges the single in-flight slot so the next flushed group
+            is parked in _claim_replica when stop() arrives."""
+
+            def __init__(self, inner, entered, gate):
+                self._inner = inner
+                self._entered = entered
+                self._gate = gate
+                self._first = True
+
+            def run(self, replica, x_dev):
+                if self._first:
+                    self._first = False
+                    self._entered.set()
+                    self._gate.wait(30)
+                return self._inner.run(replica, x_dev)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        entered, gate = threading.Event(), threading.Event()
+        from distributedpytorch_tpu.serve.server import Server
+
+        server = Server(
+            SlowRun(engine, entered, gate), inflight_per_replica=1,
+            placement_depth=1, slo_ms=1.0,
+        ).start()
+        img = np.zeros((32, 48, 3), np.float32)
+        first = server.submit(img)  # occupies the only slot, run() wedged
+        assert entered.wait(10), "first request never dispatched"
+        second = server.submit(img)  # flushed → parked waiting for a slot
+        import time as _time
+
+        _time.sleep(0.1)
+        server.stop(drain=False, timeout=1.0)
+        gate.set()
+        # liveness: BOTH futures resolve — the parked one as shutdown
+        assert first.result(30).status in ("ok", "shutdown", "error")
+        assert second.result(10).status in ("shutdown", "error")
+
+    def test_placement_failure_contained_to_its_group(self, engine):
+        """A device_put failure after the slot is claimed must resolve
+        THAT group's futures as errors, return the slot, and leave the
+        server serving — not kill the loop with futures unresolved."""
+
+        class FailOnce:
+            def __init__(self, inner):
+                self._inner = inner
+                self._fail = True
+
+            def place(self, replica, batch):
+                if self._fail:
+                    self._fail = False
+                    raise RuntimeError("injected placement failure")
+                return self._inner.place(replica, batch)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        from distributedpytorch_tpu.serve.server import Server
+
+        server = Server(FailOnce(engine)).start()
+        try:
+            img = np.zeros((32, 48, 3), np.float32)
+            first = server.submit(img).result(30)
+            assert first.status == "error"
+            assert "injected placement failure" in first.reason
+            # the slot came back and the loop survived: next request OK
+            second = server.submit(img).result(30)
+            assert second.ok
+        finally:
+            server.stop()
+
+    def test_bad_input_is_an_error_response(self, engine):
+        server = self._serve(engine)
+        try:
+            response = server.submit(
+                np.zeros((7, 7, 3), np.float32)
+            ).result(5)
+            assert response.status == "error"
+            assert "expected" in response.reason
+        finally:
+            server.stop()
+
+
+class TestHTTP:
+    def test_roundtrip_health_stats_predict(self, trained, engine):
+        from distributedpytorch_tpu.serve.cli import make_http_server
+        from distributedpytorch_tpu.serve.server import Server
+
+        _tmp, images_dir = trained
+        offline = _predict_masks(trained, batch_size=1)
+        server = Server(engine).start()
+        httpd = make_http_server(server, port=0)
+        port = httpd.server_address[1]
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+            conn.request("GET", "/healthz")
+            health = json.loads(conn.getresponse().read())
+            assert health["status"] == "ok"
+            assert health["buckets"] == [1, 2, 4]
+
+            with open(_image_files(images_dir)[0], "rb") as f:
+                body = f.read()
+            conn.request("POST", "/predict", body=body)
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == "image/png"
+            mask = np.asarray(Image.open(io.BytesIO(resp.read())))
+            np.testing.assert_array_equal(mask, offline[0])
+
+            conn.request("POST", "/predict", body=b"not an image")
+            assert conn.getresponse().status == 400
+
+            conn.request("GET", "/stats")
+            stats = json.loads(conn.getresponse().read())
+            assert stats["requests_ok"] >= 1
+            conn.close()
+        finally:
+            httpd.shutdown()
+            server.stop()
+
+
+class TestBenchServe:
+    def test_report_shape_and_bounded_overload(self):
+        """The acceptance path: a (short) load-generator run completes
+        end to end on CPU and reports p50/p99 + imgs/s at >= 3
+        concurrency levels, with overload depth bounded."""
+        import tools.bench_serve as bench_serve
+
+        args = bench_serve.get_args([
+            "--image-size", "48", "32",
+            "--buckets", "1", "2", "4",
+            "--replicas", "1",
+            "--levels", "1", "2", "4",
+            "--duration", "0.6",
+        ])
+        report = bench_serve.run_bench(budget_s=60.0, args=args)
+        assert len(report["levels"]) >= 3
+        for row in report["levels"]:
+            assert row["p50_ms"] is not None
+            assert row["p99_ms"] is not None
+            assert row["imgs_per_s"] > 0
+        assert report["overload"]["depth_bounded"]
+        assert (
+            report["overload"]["queue_depth_max"]
+            <= report["overload"]["queue_depth_cap"]
+        )
+        json.dumps(report)  # must be a writable JSON artifact
+
+    def test_cli_config_mapping(self):
+        from distributedpytorch_tpu.serve.cli import get_args, to_config
+
+        cfg = to_config(get_args([
+            "-c", "singleGPU", "--buckets", "2", "4", "--slo-ms", "10",
+            "--replicas", "3", "--no-eager", "--queue-cap", "32",
+        ]))
+        assert cfg.checkpoint == "singleGPU"
+        assert cfg.bucket_sizes == (2, 4)
+        assert cfg.slo_ms == 10.0
+        assert cfg.replicas == 3
+        assert cfg.eager_when_idle is False
+        assert cfg.queue_cap_images == 32

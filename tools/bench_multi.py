@@ -73,6 +73,15 @@ CONFIGS = [
     # single-chip window the sweep records a skip line and exits clean
     # (no chip time wasted). Cheap, bounded cells → a 300 s budget.
     ("pipeline_sched_sweep", {"BENCH_PIPELINE_SWEEP": "1"}, 300.0),
+    # Serving-tier load generator (tools/bench_serve.py): closed-loop
+    # concurrency sweep + in-SLO and overload open-loop runs against the
+    # AOT-compiled continuous-batching server (docs/SERVING.md). Safe
+    # compile class (plain eval forwards, the same executables the
+    # analyzer's --hlo tier AOT-compiles); single-process data-parallel
+    # replicas, NO collectives — the static preflight correctly has
+    # nothing to check for it (see _preflight_combos). Budget covers
+    # per-bucket×replica AOT compiles + ~7 bounded measurement legs.
+    ("serve_bench", {"BENCH_SERVE": "1"}, 600.0),
     # taps scoped to the top s2d level only (320x480 planes = 153600 px;
     # the next level down is 38400): where the tall-contraction win
     # concentrates, at a severalfold smaller XLA graph than full taps —
@@ -232,7 +241,11 @@ def _preflight_combos(env: dict):
     what the static preflight must clear. Single-device bench configs
     run no collectives (nothing to check statically, and the analyzer's
     lint layer is CI's job, not a chip window's); the pipeline schedule
-    sweep traces the MP schedules the analyzer owns."""
+    sweep traces the MP schedules the analyzer owns. The serve bench
+    (BENCH_SERVE) is deliberately in the no-combos class: its replica
+    groups are independent single-device executables with no collective
+    program, so a static collective check would be vacuous — it must
+    skip, not block (tests/test_bench_multi.py pins this)."""
     if env.get("BENCH_PIPELINE_SWEEP") == "1":
         return (("MP", ("gpipe", "1f1b")),)
     return ()
@@ -317,6 +330,12 @@ def _run_one(bench, name: str, env: dict, budget: float) -> dict:
             from tools.bench_pipeline import schedule_sweep
 
             return schedule_sweep(budget_s=budget)
+        if env.get("BENCH_SERVE") == "1":
+            # serving-tier load generator: in-process closed+open-loop
+            # sweep (tools/bench_serve.py), not a train-step measurement
+            from tools.bench_serve import run_bench
+
+            return run_bench(budget_s=budget)
         # run() reads the lever envs itself but takes batch/arch/geometry
         # from module globals frozen at bench import — re-derive them here.
         bench.BATCH = int(env.get("BENCH_BATCH", 4))

@@ -1,0 +1,313 @@
+#!/usr/bin/env python3
+"""Serving-tier load generator: closed- and open-loop, JSON report.
+
+Two complementary load shapes against the SAME in-process
+:class:`~distributedpytorch_tpu.serve.server.Server` the HTTP CLI runs
+(so the numbers measure the production path, not a bench-only shortcut):
+
+* **closed loop** — C worker threads, each submit→wait→repeat. Measures
+  the latency/throughput curve AT each concurrency level: batches form
+  exactly when concurrency exceeds replica capacity, so imgs/s vs C is
+  the continuous-batching win made visible. Reported at >= 3 levels.
+* **open loop** — arrivals on a fixed-rate clock regardless of
+  completions (the real-traffic shape closed loops can't produce,
+  coordinated-omission-free). The **overload scenario** drives the
+  arrival rate to a multiple of the measured capacity and samples queue
+  depth continuously: the report must show depth bounded by the
+  admission cap (bucket-shedding + rejection), NOT unbounded latency
+  growth — that boundedness is the acceptance criterion of the
+  serving tier's degradation story.
+
+No checkpoint needed: ``--fresh-init`` (the default when no checkpoint
+is given) serves a seeded randomly-initialized model — garbage masks,
+identical machinery — so the bench runs on any CPU, chip-free. Wired as
+the ``serve_bench`` bench_multi config (non-collective: the static
+preflight has nothing to check and skips it).
+
+Usage:
+    python tools/bench_serve.py --levels 1 4 16 --duration 5 \\
+        --out serve_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Tiny default rig: the serving machinery (queue, placement, AOT
+# executables, completion drain) is geometry-independent; a small model
+# keeps the bench hostable on the 1-2 core CI/container CPUs.
+DEFAULT_WIDTHS = (8, 16)
+DEFAULT_SIZE_WH = (96, 64)  # (W, H), CLI order
+DEFAULT_BUCKETS = (1, 2, 4, 8)
+
+
+def build_engine(args):
+    """Engine from a checkpoint, or fresh-init (seeded) when none given."""
+    from distributedpytorch_tpu.serve.engine import (
+        ServeEngine,
+        engine_from_checkpoint,
+    )
+
+    widths = tuple(args.model_widths) if args.model_widths else None
+    common = dict(
+        bucket_sizes=tuple(args.buckets),
+        replicas=args.replicas,
+        host_cache_mb=0,  # bench submits pre-decoded arrays
+    )
+    if args.checkpoint:
+        return engine_from_checkpoint(
+            args.checkpoint,
+            checkpoint_dir=args.checkpoint_dir,
+            image_size=tuple(args.image_size),
+            model_arch=args.model_arch,
+            model_widths=widths,
+            s2d_levels=args.s2d_levels,
+            **common,
+        )
+    import jax
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+
+    w, h = int(args.image_size[0]), int(args.image_size[1])
+    cfg = TrainConfig(
+        model_arch=args.model_arch,
+        model_widths=widths,
+        compute_dtype="float32",
+        s2d_levels=args.s2d_levels,
+    )
+    model, init_fn = create_model(cfg)
+    params, model_state = init_fn(jax.random.key(args.seed), (h, w))
+    return ServeEngine(model, params, model_state, input_hw=(h, w), **common)
+
+
+def make_images(n: int, hw, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.random((n, hw[0], hw[1], 3), dtype=np.float32)
+
+
+def _new_server(engine, args):
+    from distributedpytorch_tpu.serve.server import Server
+
+    return Server(
+        engine,
+        slo_ms=args.slo_ms,
+        hard_cap_images=args.queue_cap,
+        placement_depth=args.placement_depth,
+        eager_when_idle=not args.no_eager,
+    ).start()
+
+
+def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
+    """C workers, submit→wait→repeat for ``duration_s``. A fresh Server
+    per level (the compiled engine is reused) keeps each level's metrics
+    and queue counters isolated."""
+    server = _new_server(engine, args)
+    images = make_images(max(2 * concurrency, 16), engine.input_hw, args.seed)
+    stop_at = time.monotonic() + duration_s
+    errors: List[str] = []
+
+    def worker(wid: int) -> None:
+        i = wid
+        while time.monotonic() < stop_at:
+            fut = server.submit(images[i % len(images)], key=f"c{wid}-{i}")
+            response = fut.result(timeout=60.0)
+            if response.status not in ("ok", "rejected"):
+                errors.append(f"{response.status}: {response.reason}")
+                return
+            i += concurrency
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(w,), daemon=True)
+        for w in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60.0)
+    elapsed = time.monotonic() - t0
+    server.stop(drain=True)
+    snap = server.metrics.snapshot(elapsed_s=elapsed)
+    return {
+        "mode": "closed",
+        "concurrency": concurrency,
+        "requests": snap["requests_ok"],
+        "imgs_per_s": snap["imgs_per_s"],
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "pad_ratio": snap["pad_ratio"],
+        "bucket_dispatches": snap["bucket_dispatches"],
+        "errors": errors[:3],
+    }
+
+
+def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
+              label: str = "open") -> dict:
+    """Fixed-rate arrivals + a queue-depth sampler. Latency percentiles
+    cover ACCEPTED requests; rejections are counted, not averaged in —
+    under overload the interesting numbers are (a) bounded depth and
+    (b) how much got shed, separately."""
+    server = _new_server(engine, args)
+    images = make_images(32, engine.input_hw, args.seed)
+    period = 1.0 / max(rate_imgs_per_s, 1e-9)
+    futures = []
+    depth_samples: List[int] = []
+    stop = threading.Event()
+
+    def sampler() -> None:
+        while not stop.is_set():
+            depth_samples.append(server.queue.depth_images)
+            time.sleep(0.002)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
+    t0 = time.monotonic()
+    n = 0
+    while True:
+        now = time.monotonic()
+        if now - t0 >= duration_s:
+            break
+        due = t0 + n * period
+        if now < due:
+            time.sleep(min(due - now, period))
+            continue
+        futures.append(server.submit(images[n % len(images)], key=f"o{n}"))
+        n += 1
+    responses = [f.result(timeout=60.0) for f in futures]
+    elapsed = time.monotonic() - t0
+    stop.set()
+    sampler_t.join(timeout=2.0)
+    server.stop(drain=True)
+    snap = server.metrics.snapshot(elapsed_s=elapsed)
+    rejected = sum(1 for r in responses if r.status == "rejected")
+    return {
+        "mode": label,
+        "offered_imgs_per_s": round(rate_imgs_per_s, 2),
+        "submitted": len(responses),
+        "ok": sum(1 for r in responses if r.ok),
+        "rejected": rejected,
+        "imgs_per_s": snap["imgs_per_s"],
+        "p50_ms": snap["p50_ms"],
+        "p99_ms": snap["p99_ms"],
+        "queue_depth_max": max(depth_samples, default=0),
+        "queue_depth_cap": server.queue.hard_cap_images,
+        "depth_bounded": (
+            max(depth_samples, default=0) <= server.queue.hard_cap_images
+        ),
+        "pad_ratio": snap["pad_ratio"],
+    }
+
+
+def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None,
+              levels: Optional[Sequence[int]] = None) -> dict:
+    """The whole program: closed-loop sweep over the concurrency levels,
+    one in-SLO open-loop run, one overload run. Returns the report dict
+    (bench_multi appends it to the session artifact verbatim)."""
+    args = args or get_args([])
+    levels = [int(c) for c in (levels or args.levels)]
+    t_start = time.monotonic()
+
+    engine = build_engine(args)
+    engine.warmup()
+
+    # budget split: levels + 2 open-loop scenarios, capped per-leg
+    legs = len(levels) + 2
+    leg_s = max(1.0, min(args.duration, (budget_s * 0.8) / legs))
+
+    report = {
+        "metric": "serve_bench",
+        "image_size": list(args.image_size),
+        "buckets": list(args.buckets),
+        "replicas_requested": args.replicas,
+        "replicas": engine.num_replicas,
+        "slo_ms": args.slo_ms,
+        "eager_when_idle": not args.no_eager,
+        "leg_duration_s": round(leg_s, 2),
+        "levels": [],
+    }
+    for concurrency in levels:
+        row = closed_loop(engine, args, concurrency, leg_s)
+        report["levels"].append(row)
+        print(json.dumps(row), flush=True)
+
+    # capacity estimate = best closed-loop throughput; open-loop in-SLO
+    # at 60% of it, overload at 3x — overload MUST show bounded depth
+    capacity = max(
+        (row["imgs_per_s"] or 0.0) for row in report["levels"]
+    ) or 10.0
+    report["in_slo"] = open_loop(
+        engine, args, rate_imgs_per_s=0.6 * capacity, duration_s=leg_s,
+        label="open_in_slo",
+    )
+    print(json.dumps(report["in_slo"]), flush=True)
+    report["overload"] = open_loop(
+        engine, args, rate_imgs_per_s=3.0 * capacity, duration_s=leg_s,
+        label="open_overload",
+    )
+    print(json.dumps(report["overload"]), flush=True)
+    report["elapsed_s"] = round(time.monotonic() - t_start, 2)
+    report["value"] = capacity  # headline: peak closed-loop imgs/s
+    return report
+
+
+def get_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--checkpoint", "-c", default=None,
+                    help="Checkpoint name/path; default: fresh-init weights "
+                         "(identical machinery, garbage masks)")
+    ap.add_argument("--checkpoint-dir", default="./checkpoints")
+    ap.add_argument("--image-size", type=int, nargs=2,
+                    default=DEFAULT_SIZE_WH, metavar=("W", "H"))
+    ap.add_argument("--model", dest="model_arch", default="unet",
+                    choices=["unet", "milesial"])
+    ap.add_argument("--model-widths", type=int, nargs="+",
+                    default=list(DEFAULT_WIDTHS))
+    ap.add_argument("--s2d-levels", type=int, default=0)
+    ap.add_argument("--buckets", type=int, nargs="+",
+                    default=list(DEFAULT_BUCKETS))
+    ap.add_argument("--slo-ms", type=float, default=25.0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--queue-cap", type=int, default=None)
+    ap.add_argument("--placement-depth", type=int, default=2)
+    ap.add_argument("--no-eager", action="store_true")
+    ap.add_argument("--levels", type=int, nargs="+", default=[1, 4, 16],
+                    help="Closed-loop concurrency levels (>= 3 for the "
+                         "acceptance report)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="Per-leg duration cap (seconds)")
+    ap.add_argument("--budget", type=float, default=600.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=None, help="Write the report JSON here")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = get_args(argv)
+    report = run_bench(budget_s=args.budget, args=args)
+    text = json.dumps(report, indent=2)
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    print(text)
+    # acceptance: >= 3 levels reported, overload depth bounded
+    ok = (
+        len(report["levels"]) >= 3
+        and report["overload"]["depth_bounded"]
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
