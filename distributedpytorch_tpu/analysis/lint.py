@@ -42,6 +42,18 @@ with rationale and what each provably excludes: docs/ANALYSIS.md):
   unmatched one. (The jaxpr layer proves the same property dynamically
   via dual-rank tracing; this rule points at the exact source line.)
 
+* ``obs-hot-path`` — the telemetry layer's hot-path contract
+  (distributedpytorch_tpu/obs, docs/OBSERVABILITY.md): (a) record paths
+  inside ``obs/`` (functions named ``record*``/``inc``/``observe``/
+  ``set``/``span``) must not block on a device value (the blocking-sync
+  family) and must not grow without bound — a bare ``list.append`` is
+  flagged unless the target was constructed as a ``deque(maxlen=...)``
+  in the same file (the ring-slot contract); (b) package-wide, any
+  telemetry call (``obs.`` / ``obsm.`` / ``flight.`` dotted prefixes)
+  inside a jit/shard_map-traced function is flagged — it would execute
+  once at trace time and record nothing (or bake a host side effect
+  into the compiled program).
+
 Suppression: append ``# dptlint: disable=<rule>[,<rule>...]`` (or
 ``disable=all``) to the offending line, with a justification.
 """
@@ -135,6 +147,52 @@ SERVE_SANCTIONED_DRAIN_FNS = frozenset({"pull"})
 #: Deliberately NOT the `build_*`/`make_*` builders: those take (model,
 #: tx) and donate nothing.
 DONATING_CALLS = frozenset({"train_step", "multi_step", "accum_step"})
+
+
+#: The obs record-path scope (rule ``obs-hot-path``): functions with
+#: these names (or any ``record*``) inside ``obs/`` modules are the
+#: always-on recording paths — one ring slot / one counter bump is the
+#: whole allocation budget, and nothing there may touch a device value.
+OBS_RECORD_FN_NAMES = frozenset({"inc", "observe", "set", "span", "fire"})
+#: Dotted-prefix spellings of telemetry calls (``from ...obs import
+#: flight``, ``from ...obs import defs as obsm``, ``obs.flight.record``)
+#: that must never appear inside a traced function.
+OBS_CALL_PREFIXES = ("obs.", "obsm.", "flight.")
+
+
+def _is_obs_module(rel_path: str) -> bool:
+    sep = rel_path.replace("\\", "/")
+    return "/obs/" in sep or sep.startswith("obs/")
+
+
+def _is_obs_record_fn(name: str) -> bool:
+    return name.startswith("record") or name in OBS_RECORD_FN_NAMES
+
+
+def _bounded_append_targets(tree: ast.AST) -> Set[str]:
+    """Names/attribute chains assigned from a ``deque(..., maxlen=...)``
+    call anywhere in the file — appends to THOSE are bounded by
+    construction (the ring-slot idiom obs-hot-path sanctions)."""
+    bounded: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            call, targets = node.value, list(node.targets)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            # `self._events: deque = deque(maxlen=...)` — the ring idiom
+            call, targets = node.value, [node.target]
+        else:
+            continue
+        if not isinstance(call, ast.Call) or _terminal(call.func) != "deque":
+            continue
+        if not any(kw.arg == "maxlen" for kw in call.keywords):
+            continue
+        for t in targets:
+            key = _expr_key(t)
+            if key:
+                # `self._events` assigned in __init__ is read as
+                # `self._events` at the append site too
+                bounded.add(key)
+    return bounded
 
 
 def _donating_call(terminal: str) -> bool:
@@ -321,6 +379,8 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
             layer="lint",
         ))
 
+    in_obs_module = _is_obs_module(rel_path)
+    bounded_appends = _bounded_append_targets(tree) if in_obs_module else set()
     in_hot_file = any(rel_path.endswith(sfx) for sfx, _fn in HOT_PATH_SCOPES)
     hot_fn_names = {fn for sfx, fn in HOT_PATH_SCOPES
                     if rel_path.endswith(sfx)}
@@ -410,6 +470,47 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                 f"replica stalls behind it; device→host reads belong in "
                 f"the completion drain (`pull`), which resolves request "
                 f"futures off the dispatch path",
+            )
+
+        # -- obs-hot-path (a): obs record paths must not block or grow
+        # unboundedly — the always-on contract is one ring slot / one
+        # counter bump per event (docs/OBSERVABILITY.md)
+        in_obs_record = in_obs_module and any(
+            _is_obs_record_fn(info.name) for info in chain
+        )
+        if in_obs_record and (blocks or dotted in HOT_SYNC_CALLS):
+            emit(
+                "obs-hot-path", node,
+                f"`{dotted or term}` blocks on a device value inside an "
+                f"obs record path — telemetry is always-on and rides hot "
+                f"loops; record host-computed values only",
+            )
+        if (
+            in_obs_record
+            and term == "append"
+            and isinstance(node.func, ast.Attribute)
+        ):
+            target = _expr_key(node.func.value)
+            if target is not None and target not in bounded_appends:
+                emit(
+                    "obs-hot-path", node,
+                    f"`{target}.append` in an obs record path grows "
+                    f"without bound — always-on recording must be a "
+                    f"ring: construct `{target}` as "
+                    f"`deque(maxlen=...)`",
+                )
+
+        # -- obs-hot-path (b): telemetry calls inside traced functions
+        # execute ONCE at trace time — the metric/event silently never
+        # records (and a constant side effect bakes into the program)
+        if traced and dotted is not None and dotted.startswith(
+            OBS_CALL_PREFIXES
+        ):
+            emit(
+                "obs-hot-path", node,
+                f"`{dotted}` inside a jit/shard_map-traced function runs "
+                f"once at trace time and never again — record from the "
+                f"host loop (or a drain) instead",
             )
 
     # -- use-after-donation (per function body, EXCLUDING nested defs:

@@ -86,7 +86,19 @@ def get_args():
                         metavar="PATH",
                         help="Append per-phase step-timeline spans "
                              "(decode/stack/h2d/dispatch/readback) to this "
-                             "JSONL file; summarize with bench.py")
+                             "JSONL file; summarize with bench.py, export "
+                             "to Perfetto via obs/trace_hub.py (rank R of "
+                             "a multi-process run writes PATH.rankR)")
+    parser.add_argument("--metrics-port", type=int, default=None,
+                        metavar="PORT",
+                        help="Serve Prometheus /metrics (+ /healthz) on "
+                             "this port for the run (rank R binds PORT+R; "
+                             "0 = ephemeral)")
+    parser.add_argument("--profile-steps", type=str, default=None,
+                        metavar="N:M",
+                        help="Capture a jax.profiler device trace from "
+                             "global step N until step M into "
+                             "--profile-dir (default <log-dir>/profile)")
     parser.add_argument("--steps-per-dispatch", type=int, default=1,
                         help="Optimizer steps fused into one XLA dispatch "
                              "(amortizes runtime dispatch latency)")
@@ -184,6 +196,24 @@ def resolve_checkpoint_arg(args):
     return args.checkpoint or args.load or None
 
 
+def parse_profile_steps(text):
+    """``--profile-steps N:M`` → (N, M) with 0 <= N < M."""
+    if not text:
+        return None
+    try:
+        lo, _, hi = str(text).partition(":")
+        lo, hi = int(lo), int(hi)
+    except ValueError:
+        raise ValueError(
+            f"--profile-steps expects N:M (global steps), got {text!r}"
+        ) from None
+    if lo < 0 or hi <= lo:
+        raise ValueError(
+            f"--profile-steps needs 0 <= N < M, got {text!r}"
+        )
+    return (lo, hi)
+
+
 def _channel_shaped(exc: BaseException) -> bool:
     """Does this exception look like a dead/flapping runtime channel —
     i.e. a PEER failure, not this rank's own bug? One definition with
@@ -268,6 +298,8 @@ def main():
         checkpoint_dir=args.checkpoint_dir,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_interval_s=args.heartbeat_interval,
+        metrics_port=args.metrics_port,
+        profile_steps=parse_profile_steps(args.profile_steps),
     )
 
     # logfile parity: ./logs/{method}.log, append, message-only (reference

@@ -230,13 +230,24 @@ class TrainConfig:
     # fill K train as ordinary single steps.
     grad_accum: int = 1
 
-    # -- observability ------------------------------------------------------
+    # -- observability (distributedpytorch_tpu/obs, docs/OBSERVABILITY.md) --
     metric_every_steps: int = 10  # reference records every 10 (train_utils.py:75)
     profile_dir: Optional[str] = None  # jax.profiler trace capture when set
     # Step-timeline tracer (utils/trace.py): per-phase host spans
     # (decode/stack/h2d/dispatch/readback) appended to this JSONL path;
-    # summarized by bench.py. None = tracing off (no-op call sites).
+    # summarized by bench.py, exported to Perfetto by obs/trace_hub.py.
+    # Multi-process runs: rank 0 writes the path, rank R appends .rankR.
+    # None = JSONL off (spans still feed the flight recorder's ring).
     timeline_path: Optional[str] = None
+    # Serve GET /metrics (Prometheus text exposition of the process-wide
+    # registry) + /healthz on this port for the run's lifetime. Rank R of
+    # a multi-process job binds port+R (one scrape target per rank).
+    # 0 = ephemeral (tests read trainer.metrics_server.port); None = off.
+    metrics_port: Optional[int] = None
+    # On-demand device profile over a step range: capture a
+    # jax.profiler trace from global step N until M (inclusive:exclusive)
+    # into profile_dir (default <log_dir>/profile). None = off.
+    profile_steps: Optional[Tuple[int, int]] = None
 
     @property
     def val_fraction(self) -> float:

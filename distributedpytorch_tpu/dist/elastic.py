@@ -70,6 +70,8 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from distributedpytorch_tpu.dist import health
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +178,8 @@ class ElasticSupervisor:
         cwd: Optional[str] = None,
         preflight: bool = True,
         preflight_timeout_s: float = 300.0,
+        trace: bool = True,
+        metrics_port: Optional[int] = None,
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -213,6 +217,12 @@ class ElasticSupervisor:
         self.preflight = bool(preflight)
         self.preflight_timeout_s = float(preflight_timeout_s)
         self.preflight_findings: List[str] = []
+        # telemetry (docs/OBSERVABILITY.md): per-rank step timelines are
+        # armed by default — every elastic run is a diagnostic context,
+        # and a dead attempt's merged Perfetto trace is its post-mortem
+        self.trace = bool(trace)
+        self.metrics_port = metrics_port
+        self.merged_timeline: Optional[str] = None
 
         # resume coordinates, parsed from the worker argv (the trainer's
         # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt)
@@ -241,7 +251,8 @@ class ElasticSupervisor:
         self._procs: List[subprocess.Popen] = []
 
     # ------------------------------------------------------------------
-    def _worker_env(self, rank: int, world: int, port: int) -> Dict[str, str]:
+    def _worker_env(self, rank: int, world: int, port: int,
+                    attempt: int = 0) -> Dict[str, str]:
         if self.cpu_devices > 0:
             # CPU-mesh drills/tests: ONE definition of the virtual-device
             # provisioning moves (utils/provision.py — jax-free module)
@@ -266,6 +277,12 @@ class ElasticSupervisor:
             "DPT_DIST_INIT_TIMEOUT_S",
             str(int(max(30.0, self.spawn_timeout_s))),
         )
+        # worker flight-recorder dumps (obs/flight.py) land with the
+        # attempt's other artifacts (rank logs, beats, timelines)
+        env.setdefault(
+            "DPT_FLIGHT_DIR",
+            os.path.join(self.run_dir, f"attempt{attempt}"),
+        )
         # per-rank persistent XLA compilation caches: co-launched ranks
         # compiling identical tiny-model entries race a shared cache dir
         # (same reason tests/test_multiprocess.py splits per rank)
@@ -280,6 +297,11 @@ class ElasticSupervisor:
             "--heartbeat-dir", self._hb_dir(attempt),
             "--heartbeat-interval", str(self.heartbeat_interval_s),
         ]
+        if self.trace:
+            # one base path per attempt; rank 0 writes it, rank R writes
+            # <path>.rankR (train/loop.py) — merged after the run by the
+            # trace hub into one rank-disambiguated Perfetto timeline
+            argv += ["--trace-timeline", self._timeline_base(attempt)]
         if attempt == 0:
             for spec in self.chaos:
                 argv += ["--inject-fault", spec]
@@ -295,6 +317,28 @@ class ElasticSupervisor:
         # fresh beat dir per attempt: stale beats from a torn-down world
         # must never be classified against the relaunched one
         return os.path.join(self.run_dir, f"attempt{attempt}", "heartbeat")
+
+    def _timeline_base(self, attempt: int) -> str:
+        return os.path.join(self.run_dir, f"attempt{attempt}",
+                            "timeline.jsonl")
+
+    def _merge_timelines(self) -> Optional[str]:
+        """Merge every attempt's per-rank timeline JSONL into ONE
+        Perfetto trace for the whole supervised job (rank-disambiguated
+        tracks; docs/OBSERVABILITY.md). Never raises — this runs on the
+        report path of jobs that may already be failing."""
+        if not self.trace:
+            return None
+        from distributedpytorch_tpu.obs import trace_hub
+
+        pairs: List = []
+        for attempt in range(len(self.world_history)):
+            pairs.extend(trace_hub.timeline_rank_paths(
+                self._timeline_base(attempt)
+            ))
+        out = os.path.join(self.run_dir, "timeline_merged.json")
+        self.merged_timeline = trace_hub.write_merged_trace(pairs, out)
+        return self.merged_timeline
 
     def _log_path(self, attempt: int, rank: int) -> str:
         return os.path.join(
@@ -319,7 +363,7 @@ class ElasticSupervisor:
                 self._procs.append(
                     subprocess.Popen(
                         argv,
-                        env=self._worker_env(rank, world, port),
+                        env=self._worker_env(rank, world, port, attempt),
                         cwd=self.cwd,
                         stdout=log_f,
                         stderr=subprocess.STDOUT,
@@ -470,6 +514,8 @@ class ElasticSupervisor:
         }
         if self.preflight_findings:
             payload["preflight_findings"] = list(self.preflight_findings)
+        if self.merged_timeline:
+            payload["merged_timeline"] = self.merged_timeline
         tmp = f"{self.report_path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=2)
@@ -494,11 +540,26 @@ class ElasticSupervisor:
                 )
                 self._write_report(final="static_check_failed")
                 return STATIC_CHECK_EXIT
+        metrics_server = None
+        if self.metrics_port is not None:
+            from distributedpytorch_tpu.obs.http import start_metrics_server
+
+            metrics_server = start_metrics_server(self.metrics_port)
+            logger.info("elastic: serving /metrics on port %d",
+                        metrics_server.port)
+        try:
+            return self._run_supervised()
+        finally:
+            if metrics_server is not None:
+                metrics_server.close()
+
+    def _run_supervised(self) -> int:
         world = self.nprocs
         attempt = 0
         consecutive_fails = {r: 0 for r in range(world)}
         while True:
             self.world_history.append(world)
+            obsm.ELASTIC_WORLD_SIZE.set(world)
             t0 = time.monotonic()
             self._spawn(attempt, world)
             verdicts = self._watch(attempt, world)
@@ -520,7 +581,17 @@ class ElasticSupervisor:
                     duration_s=time.monotonic() - t0,
                 )
             )
+            obsm.ELASTIC_ATTEMPTS.labels(
+                outcome="ok" if not failed else "failed"
+            ).inc()
+            for h in failed.values():
+                obsm.ELASTIC_RANK_FAILURES.labels(
+                    failure_class=h.state
+                ).inc()
+                flight.record("rank_failure", rank=h.rank, state=h.state,
+                              epoch=h.epoch, step=h.step)
             if not failed:
+                self._merge_timelines()
                 self._write_report(final="ok")
                 logger.info(
                     "elastic job complete: %d restart(s), world history %s",
@@ -532,7 +603,14 @@ class ElasticSupervisor:
             for line in lines:
                 logger.error("%s", line)
             if self.restarts >= self.max_restarts:
+                self._merge_timelines()
                 self._write_report(final="failed")
+                flight.dump(
+                    "elastic_budget_exhausted",
+                    path=os.path.join(self.run_dir, "flight_supervisor.json"),
+                    extra={"failures": lines,
+                           "world_history": self.world_history},
+                )
                 logger.error(
                     "elastic job failed: restart budget (%d) exhausted; "
                     "per-rank logs under %s",
@@ -565,6 +643,7 @@ class ElasticSupervisor:
                 world = new_world
                 consecutive_fails = {r: 0 for r in range(world)}
             self.restarts += 1
+            obsm.ELASTIC_RESTARTS.inc()
             self._write_report(final=None)
             backoff = self.restart_backoff_s * (2.0 ** (self.restarts - 1))
             logger.warning(
@@ -627,6 +706,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--preflight-timeout", type=float, default=300.0,
                     help="Preflight subprocess budget (s); an analyzer "
                          "that cannot run never blocks the launch")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="Do not arm per-rank step timelines "
+                         "(--trace-timeline) or merge them into the "
+                         "run's Perfetto trace (<run-dir>/"
+                         "timeline_merged.json)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="Serve the supervisor's Prometheus /metrics "
+                         "(restarts, world size, per-rank failure "
+                         "classes) on this port")
     ap.add_argument("worker_args", nargs=argparse.REMAINDER,
                     help="Training CLI args (prefix with --)")
     args = ap.parse_args(argv)
@@ -654,6 +742,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         chaos=args.chaos,
         preflight=not args.no_preflight,
         preflight_timeout_s=args.preflight_timeout,
+        trace=not args.no_trace,
+        metrics_port=args.metrics_port,
     )
     return sup.run()
 

@@ -1,0 +1,106 @@
+"""Metric family catalog — one place, created eagerly at import.
+
+Every family the train loop, the serve tier, and the elastic supervisor
+record into is defined HERE, against the process-wide registry, so any
+``/metrics`` endpoint in any process exposes the full catalog (families
+a given process never touches expose at zero / header-only — the
+Prometheus-idiomatic shape, and what the acceptance check "exposition
+covering train, serve, and supervisor metric families" keys on).
+
+Import as ``from distributedpytorch_tpu.obs import defs as obsm`` —
+the ``obsm.`` prefix is what dptlint's ``obs-hot-path`` rule matches
+when checking that no metric update happens inside a jit/shard_map-
+traced function (docs/ANALYSIS.md).
+
+The full catalog with semantics lives in docs/OBSERVABILITY.md.
+"""
+
+from __future__ import annotations
+
+from distributedpytorch_tpu.obs.registry import REGISTRY
+
+# -- train (recorded by train/loop.py + utils/metrics.py at drain
+#    boundaries — never on the dispatch hot path) ---------------------------
+TRAIN_STEPS = REGISTRY.counter(
+    "dpt_train_steps_total", "Optimizer steps completed")
+TRAIN_IMAGES = REGISTRY.counter(
+    "dpt_train_images_total", "Training images consumed")
+TRAIN_LOSS = REGISTRY.gauge(
+    "dpt_train_loss", "Last drained mean-of-window train loss")
+TRAIN_VAL_LOSS = REGISTRY.gauge(
+    "dpt_train_val_loss", "Last epoch validation loss")
+TRAIN_VAL_DICE = REGISTRY.gauge(
+    "dpt_train_val_dice", "Last epoch validation Dice")
+TRAIN_STEP_SECONDS = REGISTRY.histogram(
+    "dpt_train_step_seconds",
+    "Host-observed step-loop iteration time (dispatch cadence, not "
+    "device latency)",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0, 10.0, 30.0, 60.0, 300.0),
+)
+TRAIN_IMGS_PER_S = REGISTRY.gauge(
+    "dpt_train_imgs_per_s", "Steady-state training throughput")
+TRAIN_RETRIES = REGISTRY.counter(
+    "dpt_train_retries_total",
+    "Bounded-backoff retries of transient host failures", ("site",))
+TRAIN_ROLLBACKS = REGISTRY.counter(
+    "dpt_train_rollbacks_total",
+    "Checkpoint rollbacks consumed by the non-finite-loss policy")
+TRAIN_SKIPPED_STEPS = REGISTRY.counter(
+    "dpt_train_skipped_steps_total",
+    "Updates discarded by the non-finite-loss 'skip' policy")
+CACHE_HITS = REGISTRY.counter(
+    "dpt_host_cache_hits_total", "Decoded-sample cache hits")
+CACHE_MISSES = REGISTRY.counter(
+    "dpt_host_cache_misses_total", "Decoded-sample cache misses")
+CACHE_HIT_RATIO = REGISTRY.gauge(
+    "dpt_host_cache_hit_ratio", "Decoded-sample cache hit rate [0, 1]")
+
+# -- serve (recorded by serve/metrics.py off the dispatch loop) -------------
+SERVE_REQUESTS = REGISTRY.counter(
+    "dpt_serve_requests_total", "Requests resolved", ("status",))
+SERVE_IMAGES = REGISTRY.counter(
+    "dpt_serve_images_total", "Images served successfully")
+SERVE_REJECTIONS = REGISTRY.counter(
+    "dpt_serve_rejections_total", "Requests rejected at admission",
+    ("reason",))
+SERVE_DISPATCHES = REGISTRY.counter(
+    "dpt_serve_dispatches_total", "Bucket executables dispatched",
+    ("bucket",))
+SERVE_PAD_ROWS = REGISTRY.counter(
+    "dpt_serve_pad_rows_total", "Pad rows dispatched")
+SERVE_REAL_ROWS = REGISTRY.counter(
+    "dpt_serve_real_rows_total", "Real rows dispatched")
+SERVE_FLUSHES = REGISTRY.counter(
+    "dpt_serve_queue_flushes_total",
+    "Batching-queue flush decisions by regime "
+    "(full/deadline/eager/shed)", ("kind",))
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "dpt_serve_queue_depth_images", "Pending images in the batching queue")
+SERVE_LATENCY = REGISTRY.histogram(
+    "dpt_serve_latency_seconds", "Request latency, admission to response",
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0),
+)
+SERVE_QUEUE_SECONDS = REGISTRY.histogram(
+    "dpt_serve_queue_seconds", "Queueing delay, admission to dispatch",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+             5.0),
+)
+
+# -- elastic supervisor (recorded by dist/elastic.py; jax-free) -------------
+ELASTIC_RESTARTS = REGISTRY.counter(
+    "dpt_elastic_restarts_total", "Supervisor relaunches of the job")
+ELASTIC_WORLD_SIZE = REGISTRY.gauge(
+    "dpt_elastic_world_size", "Ranks in the current/last attempt")
+ELASTIC_RANK_FAILURES = REGISTRY.counter(
+    "dpt_elastic_rank_failures_total",
+    "Per-rank failure verdicts across attempts", ("failure_class",))
+ELASTIC_ATTEMPTS = REGISTRY.counter(
+    "dpt_elastic_attempts_total", "Launch attempts by outcome",
+    ("outcome",))
+
+# -- obs itself -------------------------------------------------------------
+FLIGHT_DUMPS = REGISTRY.counter(
+    "dpt_flight_dumps_total", "Flight-recorder artifacts written",
+    ("reason_class",))

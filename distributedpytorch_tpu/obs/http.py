@@ -1,0 +1,125 @@
+"""Metrics HTTP endpoint for processes that have no HTTP front of
+their own (training ranks via ``--metrics-port``, the elastic
+supervisor). The serve tier mounts the same handlers on its existing
+``ThreadingHTTPServer`` (serve/cli.py) instead of opening a second
+port.
+
+Stdlib ``ThreadingHTTPServer`` on a daemon thread:
+
+* ``GET /metrics``  — Prometheus text exposition of the process-wide
+  registry;
+* ``GET /healthz``  — liveness JSON: ``status``, ``uptime_s``, and the
+  build/config fingerprint (:func:`build_fingerprint`).
+
+Port 0 binds an ephemeral port (tests); read it back from ``.port``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import threading
+import time
+from typing import Optional
+
+from distributedpytorch_tpu.obs.registry import CONTENT_TYPE, REGISTRY
+
+
+def build_fingerprint(config=None) -> dict:
+    """What build+configuration produced this process's numbers — the
+    thing a post-incident reader needs to reproduce them. ``config``
+    may be any dataclass-like object with ``__dict__``/asdict, or a
+    plain dict."""
+    from distributedpytorch_tpu import __version__
+
+    fp = {
+        "package": "distributedpytorch_tpu",
+        "version": __version__,
+        "python": sys.version.split()[0],
+    }
+    if config is not None:
+        if hasattr(config, "__dataclass_fields__"):
+            import dataclasses
+
+            items = dataclasses.asdict(config)
+        elif isinstance(config, dict):
+            items = config
+        else:
+            items = dict(vars(config))
+        blob = json.dumps(items, sort_keys=True, default=str)
+        fp["config_sha"] = hashlib.sha256(blob.encode()).hexdigest()[:12]
+    return fp
+
+
+def metrics_response(registry=None):
+    """``(body_bytes, content_type)`` of a /metrics scrape — THE
+    exposition write, shared by this module's server and the serve
+    front's handler (serve/cli.py) so the two cannot drift."""
+    return (registry or REGISTRY).expose().encode(), CONTENT_TYPE
+
+
+def healthz_payload(started_t: float, fingerprint: dict, **extra) -> dict:
+    """The /healthz JSON body (status + uptime + fingerprint), shared
+    the same way; ``extra`` carries endpoint-specific inventory (the
+    serve front adds its bucket/replica fields)."""
+    payload = {
+        "status": "ok",
+        "uptime_s": round(time.monotonic() - started_t, 3),
+        "fingerprint": fingerprint,
+    }
+    payload.update(extra)
+    return payload
+
+
+class MetricsServer:
+    """A started /metrics + /healthz endpoint; ``close()`` to stop."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 registry=None, fingerprint: Optional[dict] = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        fingerprint = fingerprint or build_fingerprint()
+        started_t = time.monotonic()
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server's contract
+                if self.path == "/metrics":
+                    self._send(200, *metrics_response(registry))
+                elif self.path == "/healthz":
+                    self._send(200, json.dumps(
+                        healthz_payload(started_t, fingerprint)
+                    ).encode(), "application/json")
+                else:
+                    self._send(404, json.dumps(
+                        {"error": f"no route {self.path}"}
+                    ).encode(), "application/json")
+
+            def log_message(self, fmt, *args):  # keep scrapes off stderr
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="dpt-metrics-http",
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def start_metrics_server(port: int, host: str = "127.0.0.1",
+                         registry=None,
+                         fingerprint: Optional[dict] = None) -> MetricsServer:
+    return MetricsServer(port, host=host, registry=registry,
+                         fingerprint=fingerprint)

@@ -1,0 +1,483 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+One registry per process (module singleton :data:`REGISTRY`), three
+metric kinds, all label-aware:
+
+* **Counter** — monotonically increasing float (``inc``); exact under
+  concurrency (each child guards its read-modify-write with a tiny
+  per-child lock — the cost is one uncontended lock acquire, cheap
+  enough for completion workers and the metrics drain, and the registry
+  is never touched from the device-dispatch hot path: dptlint's
+  ``obs-hot-path`` rule enforces that scope).
+* **Gauge** — settable float (``set``/``inc``).
+* **Histogram** — fixed cumulative buckets (Prometheus semantics:
+  ``le`` bounds, ``_sum``, ``_count`` are exact counters) plus a
+  **bounded** sample window (``deque(maxlen=...)``) for host-side
+  quantile snapshots — a long-running process must not grow memory per
+  observation (the same discipline as ``ServeMetrics``' latency
+  window).
+
+Exposition is the Prometheus text format, version 0.0.4
+(``expose()``); :func:`validate_exposition` is the strict line-format
+checker the tests and the CI smoke step run against it — a malformed
+escape or an inconsistent histogram fails loudly instead of silently
+dropping a scrape.
+
+Metric families are *created idempotently*: asking for an existing name
+with the same kind/labels returns the existing family (trainers and
+servers are constructed many times per test process), while a
+conflicting re-registration raises.
+
+Deliberately stdlib-only and jax-free: the elastic supervisor (a
+jax-free process by design) and the serve HTTP front share this module.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import math
+import re
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+#: Prometheus' default histogram ladder, widened with a 30/60 s tail
+#: (cold-compile steps and SLO drains both live out there).
+DEFAULT_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+    30.0, 60.0,
+)
+
+#: Quantile-window bound per histogram child (snapshot quantiles only —
+#: bucket counts and sums stay exact for the process lifetime).
+DEFAULT_WINDOW = 2048
+
+
+def _format_value(v: float) -> str:
+    """Prometheus sample value: integral floats render as integers
+    (counters read naturally), everything else as repr(float)."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label_value(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _escape_help(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n")
+
+
+class _Child:
+    """One (labelvalues) series of a counter/gauge family."""
+
+    __slots__ = ("_lock", "_value", "_monotonic")
+
+    def __init__(self, monotonic: bool):
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._monotonic = monotonic
+
+    def inc(self, amount: float = 1.0) -> None:
+        if self._monotonic and amount < 0:
+            raise ValueError(f"counter increments must be >= 0, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    def set(self, value: float) -> None:
+        if self._monotonic:
+            raise TypeError("counters only go up — use inc()")
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class _HistogramChild:
+    """One series of a histogram family: exact cumulative bucket counts
+    plus a bounded quantile window."""
+
+    __slots__ = ("_lock", "bounds", "_bucket_counts", "_sum", "_count",
+                 "_window")
+
+    def __init__(self, bounds: Tuple[float, ...], window: int):
+        self._lock = threading.Lock()
+        self.bounds = bounds
+        self._bucket_counts = [0] * (len(bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._window: collections.deque = collections.deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._bucket_counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._window.append(v)
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative_buckets(self) -> List[Tuple[str, int]]:
+        """[("le-bound", cumulative count), ..., ("+Inf", total)]."""
+        with self._lock:
+            counts = list(self._bucket_counts)
+        out: List[Tuple[str, int]] = []
+        running = 0
+        for bound, c in zip(self.bounds, counts[:-1]):
+            running += c
+            out.append((_format_value(bound), running))
+        out.append(("+Inf", running + counts[-1]))
+        return out
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Nearest-rank quantile over the bounded window (None when
+        nothing was observed). Snapshot-path only — sorts O(window)."""
+        with self._lock:
+            window = list(self._window)
+        if not window:
+            return None
+        ordered = sorted(window)
+        rank = max(0, min(len(ordered) - 1,
+                          round(q / 100.0 * (len(ordered) - 1))))
+        return ordered[int(rank)]
+
+
+class Family:
+    """A named metric family: labelled children or one default child."""
+
+    def __init__(self, name: str, help_text: str, kind: str,
+                 labelnames: Tuple[str, ...],
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 window: int = DEFAULT_WINDOW):
+        self.name = name
+        self.help = help_text
+        self.kind = kind  # "counter" | "gauge" | "histogram"
+        self.labelnames = labelnames
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+        if not labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        if self.kind == "histogram":
+            return _HistogramChild(self.buckets, self.window)
+        return _Child(monotonic=self.kind == "counter")
+
+    def labels(self, *values, **kv):
+        """Child for one label-value combination (created on first use)."""
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(str(kv[name]) for name in self.labelnames)
+            except KeyError as exc:
+                raise ValueError(
+                    f"{self.name} expects labels {self.labelnames}"
+                ) from exc
+            if len(kv) != len(self.labelnames):
+                raise ValueError(
+                    f"{self.name} expects labels {self.labelnames}, "
+                    f"got {sorted(kv)}"
+                )
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"{self.name} expects {len(self.labelnames)} label "
+                f"value(s), got {len(values)}"
+            )
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(values, self._make_child())
+        return child
+
+    # unlabeled conveniences ------------------------------------------------
+    def _default(self):
+        if self.labelnames:
+            raise ValueError(
+                f"{self.name} is labelled {self.labelnames} — use .labels()"
+            )
+        return self._children[()]
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._default().value
+
+    def collect(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def as_dict(self) -> Dict[str, float]:
+        """{label-values-joined: value} — JSON-snapshot convenience for
+        counters/gauges (``ServeMetrics`` rebuilds its /stats maps from
+        this)."""
+        out: Dict[str, float] = {}
+        for values, child in self.collect():
+            key = ",".join(values)
+            out[key] = child.value  # type: ignore[attr-defined]
+        return out
+
+
+class MetricsRegistry:
+    """Registry of families; see module docstring."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, Family] = {}
+
+    def _register(self, name: str, help_text: str, kind: str,
+                  labelnames: Sequence[str],
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  window: int = DEFAULT_WINDOW) -> Family:
+        if not _NAME_RE.match(name):
+            raise ValueError(f"bad metric name {name!r}")
+        labelnames = tuple(labelnames)
+        for ln in labelnames:
+            if not _LABEL_RE.match(ln) or ln.startswith("__"):
+                raise ValueError(f"bad label name {ln!r} for {name!r}")
+        with self._lock:
+            existing = self._families.get(name)
+            if existing is not None:
+                if existing.kind != kind or existing.labelnames != labelnames:
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames}, cannot "
+                        f"re-register as {kind}{labelnames}"
+                    )
+                return existing
+            fam = Family(name, help_text, kind, labelnames,
+                         buckets=buckets, window=window)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_text: str,
+                labelnames: Sequence[str] = ()) -> Family:
+        return self._register(name, help_text, "counter", labelnames)
+
+    def gauge(self, name: str, help_text: str,
+              labelnames: Sequence[str] = ()) -> Family:
+        return self._register(name, help_text, "gauge", labelnames)
+
+    def histogram(self, name: str, help_text: str,
+                  labelnames: Sequence[str] = (),
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                  window: int = DEFAULT_WINDOW) -> Family:
+        return self._register(name, help_text, "histogram", labelnames,
+                              buckets=buckets, window=window)
+
+    def get(self, name: str) -> Optional[Family]:
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self) -> List[Family]:
+        with self._lock:
+            return [self._families[k] for k in sorted(self._families)]
+
+    # -- exposition ----------------------------------------------------------
+    def expose(self) -> str:
+        """Prometheus text exposition (version 0.0.4), all families.
+        Label-less families always emit one sample (0 until touched);
+        labelled families emit one sample per child seen so far — the
+        HELP/TYPE header is emitted either way, so a scraper (and the
+        acceptance check) sees every family the process defines."""
+        lines: List[str] = []
+        for fam in self.families():
+            lines.append(f"# HELP {fam.name} {_escape_help(fam.help)}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for values, child in fam.collect():
+                labels = ",".join(
+                    f'{n}="{_escape_label_value(v)}"'
+                    for n, v in zip(fam.labelnames, values)
+                )
+                if fam.kind == "histogram":
+                    for le, cum in child.cumulative_buckets():  # type: ignore
+                        le_label = (
+                            f'{labels},le="{le}"' if labels else f'le="{le}"'
+                        )
+                        lines.append(
+                            f"{fam.name}_bucket{{{le_label}}} {cum}"
+                        )
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(
+                        f"{fam.name}_sum{suffix} "
+                        f"{_format_value(child.sum)}"  # type: ignore
+                    )
+                    lines.append(
+                        f"{fam.name}_count{suffix} {child.count}"  # type: ignore
+                    )
+                else:
+                    suffix = f"{{{labels}}}" if labels else ""
+                    lines.append(
+                        f"{fam.name}{suffix} "
+                        f"{_format_value(child.value)}"  # type: ignore
+                    )
+        return "\n".join(lines) + "\n"
+
+
+#: Prometheus text-format content type (what /metrics responds with).
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# -- strict exposition checker (tests + CI smoke) ---------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"(?:[^\"\\\n]|\\[\\\"n])*\",?)*)\})?"
+    r" (?P<value>[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN))"
+    r"(?: (?P<ts>-?[0-9]+))?$"
+)
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$"
+)
+
+
+def validate_exposition(text: str) -> Dict[str, str]:
+    """Strictly check Prometheus text exposition; returns
+    ``{family_name: type}``. Raises ``ValueError`` naming the first bad
+    line. Beyond per-line grammar it checks family-level invariants:
+    a sample must follow its family's ``# TYPE``; histogram children
+    must end their bucket ladder at ``le="+Inf"`` with the +Inf count
+    equal to ``_count`` and cumulative counts non-decreasing."""
+    types: Dict[str, str] = {}
+    # histogram bookkeeping: (family, labelset-minus-le) -> state
+    buckets: Dict[Tuple[str, str], List[Tuple[float, float]]] = {}
+    counts: Dict[Tuple[str, str], float] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if line == "":
+            continue
+        if line.startswith("#"):
+            m = _HELP_RE.match(line)
+            if m:
+                continue
+            m = _TYPE_RE.match(line)
+            if m:
+                name, kind = m.group(1), m.group(2)
+                if name in types:
+                    raise ValueError(f"line {i}: duplicate TYPE for {name}")
+                types[name] = kind
+                continue
+            raise ValueError(f"line {i}: malformed comment: {line!r}")
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: malformed sample line: {line!r}")
+        name = m.group("name")
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                family = name[: -len(suffix)]
+                break
+        if family not in types:
+            raise ValueError(
+                f"line {i}: sample {name!r} precedes its # TYPE line"
+            )
+        labels = m.group("labels") or ""
+        value = float(m.group("value").replace("Inf", "inf"))
+        if types[family] == "histogram" and name == f"{family}_bucket":
+            le = None
+            rest = []
+            for pair in filter(None, _split_labels(labels)):
+                k, _, v = pair.partition("=")
+                if k == "le":
+                    le = v.strip('"')
+                else:
+                    rest.append(pair)
+            if le is None:
+                raise ValueError(
+                    f"line {i}: histogram bucket without le label"
+                )
+            key = (family, ",".join(rest))
+            bound = float("inf") if le == "+Inf" else float(le)
+            series = buckets.setdefault(key, [])
+            if series and bound <= series[-1][0]:
+                raise ValueError(
+                    f"line {i}: bucket bounds not increasing for {family}"
+                )
+            if series and value < series[-1][1]:
+                raise ValueError(
+                    f"line {i}: cumulative bucket counts decreased "
+                    f"for {family}"
+                )
+            series.append((bound, value))
+        elif types[family] == "histogram" and name == f"{family}_count":
+            counts[(family, labels)] = value
+    for (family, labelset), series in buckets.items():
+        if not series or series[-1][0] != float("inf"):
+            raise ValueError(
+                f"histogram {family}{{{labelset}}} has no le=\"+Inf\" bucket"
+            )
+        total = counts.get((family, labelset))
+        if total is not None and series[-1][1] != total:
+            raise ValueError(
+                f"histogram {family}{{{labelset}}}: +Inf bucket "
+                f"{series[-1][1]} != _count {total}"
+            )
+    return types
+
+
+def _split_labels(labels: str) -> Iterable[str]:
+    """Split a validated label body on commas outside quotes."""
+    out: List[str] = []
+    depth_quote = False
+    cur = []
+    i = 0
+    while i < len(labels):
+        ch = labels[i]
+        if ch == "\\" and depth_quote:
+            cur.append(labels[i:i + 2])
+            i += 2
+            continue
+        if ch == '"':
+            depth_quote = not depth_quote
+        if ch == "," and not depth_quote:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+        i += 1
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+#: The process-wide registry every subsystem records into.
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

@@ -11,9 +11,13 @@ layer exists for. Endpoints:
   ``image/png`` mask ({0, 255}); ``503`` + JSON when shed capacity is
   exhausted (body carries the rejection reason), ``400`` on an
   undecodable body.
-* ``GET /healthz``  — liveness + the compiled bucket/replica inventory.
+* ``GET /healthz``  — liveness + the compiled bucket/replica inventory,
+  ``uptime_s``, and the build/config fingerprint.
 * ``GET /stats``    — the metrics snapshot (p50/p99, imgs/s, queue
-  depth, per-bucket dispatch counts, pad ratio).
+  depth, per-bucket dispatch counts, pad ratio). Schema pinned by
+  tests/test_serve.py — dashboards depend on it.
+* ``GET /metrics``  — Prometheus text exposition of the process-wide
+  telemetry registry (distributedpytorch_tpu/obs, docs/OBSERVABILITY.md).
 
 Example:
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008 \\
@@ -122,14 +126,26 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                      request_timeout_s: float = 30.0):
     """Wrap a started :class:`Server` in a ThreadingHTTPServer (port 0 =
     ephemeral; read the bound port off ``.server_address``)."""
+    import time
+
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     from PIL import Image
 
+    from distributedpytorch_tpu.obs.http import (
+        build_fingerprint,
+        healthz_payload,
+        metrics_response,
+    )
     from distributedpytorch_tpu.serve.server import (
         STATUS_REJECTED,
         STATUS_SHUTDOWN,
     )
+
+    # make_http_server builds an HTTP handler class, not a jitted fn —
+    # the make_* trace heuristic doesn't apply to this host-only module
+    started_t = time.monotonic()  # dptlint: disable=trace-nondeterminism
+    fingerprint = build_fingerprint(getattr(server, "config", None))
 
     class Handler(BaseHTTPRequestHandler):
         def _json(self, code: int, obj: dict) -> None:
@@ -142,13 +158,22 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
 
         def do_GET(self):  # noqa: N802 — http.server's contract
             if self.path == "/healthz":
-                self._json(200, {
-                    "status": "ok",
-                    "buckets": list(server.engine.planner.sizes),
-                    "replicas": server.engine.num_replicas,
-                })
+                # shared body builder (obs/http.py: status + uptime +
+                # fingerprint) + this front's compiled inventory
+                self._json(200, healthz_payload(
+                    started_t, fingerprint,
+                    buckets=list(server.engine.planner.sizes),
+                    replicas=server.engine.num_replicas,
+                ))
             elif self.path == "/stats":
                 self._json(200, server.stats())
+            elif self.path == "/metrics":
+                body, ctype = metrics_response()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 

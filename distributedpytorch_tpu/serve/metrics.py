@@ -9,6 +9,16 @@ small record under a lock; nothing in the dispatch path reads, syncs,
 or aggregates. Aggregation (percentiles, rates) happens only when
 someone asks (``snapshot()``: the /stats endpoint, the load generator's
 report, a test).
+
+Recording now rides the shared telemetry registry
+(distributedpytorch_tpu/obs): every record call updates the process-
+wide ``dpt_serve_*`` families (what ``GET /metrics`` exposes) in the
+same breath as the per-instance state. The two views deliberately
+differ in lifetime — ``/stats`` is *this server's* story (counters
+reset with the Server object; the JSON schema is pinned byte-compatible
+by tests/test_serve.py), ``/metrics`` is the *process's* story
+(Prometheus counters only ever go up, across server rebuilds) — which
+is exactly the cumulative contract scrapers rate() over.
 """
 
 from __future__ import annotations
@@ -17,6 +27,8 @@ import collections
 import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional
+
+from distributedpytorch_tpu.obs import defs as obsm
 
 
 def percentile(values: List[float], q: float) -> float:
@@ -66,14 +78,20 @@ class ServeMetrics:
             self._queue_s.append(dispatch_t - enqueue_t)
             self._images_ok += n_images
             self._requests_ok += 1
+        obsm.SERVE_REQUESTS.labels(status="ok").inc()
+        obsm.SERVE_IMAGES.inc(n_images)
+        obsm.SERVE_LATENCY.observe(done_t - enqueue_t)
+        obsm.SERVE_QUEUE_SECONDS.observe(dispatch_t - enqueue_t)
 
     def record_failure(self) -> None:
         with self._lock:
             self._requests_failed += 1
+        obsm.SERVE_REQUESTS.labels(status="failed").inc()
 
     def record_rejection(self, reason: str) -> None:
         with self._lock:
             self._rejections[reason] = self._rejections.get(reason, 0) + 1
+        obsm.SERVE_REJECTIONS.labels(reason=reason).inc()
 
     def record_dispatch(self, bucket: int, real_rows: int) -> None:
         with self._lock:
@@ -82,6 +100,10 @@ class ServeMetrics:
             )
             self._real_rows += real_rows
             self._pad_rows += bucket - real_rows
+        obsm.SERVE_DISPATCHES.labels(bucket=str(bucket)).inc()
+        obsm.SERVE_REAL_ROWS.inc(real_rows)
+        if bucket > real_rows:
+            obsm.SERVE_PAD_ROWS.inc(bucket - real_rows)
 
     # -- aggregation (pull-based; never on the dispatch path) ----------------
     def snapshot(self, elapsed_s: Optional[float] = None) -> dict:

@@ -47,6 +47,8 @@ from typing import Callable, Deque, List, Optional, Tuple
 
 import numpy as np
 
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
 from distributedpytorch_tpu.serve.bucketing import BucketPlanner
 
 #: ``submit`` rejection reasons (stable strings — they surface in bench
@@ -129,6 +131,8 @@ class BatchingQueue:
                 return REJECT_SHUTDOWN
             if self._pending_images + req.size > self.hard_cap_images:
                 self.rejected += 1
+                flight.record("queue_reject", reason=REJECT_OVERLOAD,
+                              rows=req.size, backlog=self._pending_images)
                 return REJECT_OVERLOAD
             now = self.clock()
             req.enqueue_t = now
@@ -139,6 +143,7 @@ class BatchingQueue:
             self._pending_images += req.size
             self.submitted += 1
             self.max_depth_seen = max(self.max_depth_seen, self._pending_images)
+            obsm.SERVE_QUEUE_DEPTH.set(self._pending_images)
             self._cond.notify_all()
         return None
 
@@ -168,11 +173,13 @@ class BatchingQueue:
         ):
             # head group fills (or next request overflows) the largest
             # bucket: the throughput path
+            kind = "full"
             bucket = self.planner.bucket_for(total)
         elif overloaded:
             # shed: more than a full bucket is backed up behind the head
             # group — drop to the largest bucket the head can FILL, so
             # no dispatched row is padding while real requests wait
+            kind = "shed"
             bucket = self.planner.largest_full_bucket(total)
             trimmed: List[ServeRequest] = []
             trimmed_total = 0
@@ -188,12 +195,19 @@ class BatchingQueue:
             bucket = self.planner.bucket_for(total)
         elif take[0].deadline_t <= now or eager:
             # SLO flush / work-conserving flush: smallest covering bucket
+            kind = "deadline" if take[0].deadline_t <= now else "eager"
             bucket = self.planner.bucket_for(total)
         else:
             return None
         for req in take:
             self._pending.popleft()
         self._pending_images -= total
+        # flush-decision telemetry (docs/OBSERVABILITY.md): a counter inc
+        # + one ring slot — no allocation growth, nothing blocks
+        obsm.SERVE_FLUSHES.labels(kind=kind).inc()
+        obsm.SERVE_QUEUE_DEPTH.set(self._pending_images)
+        flight.record("queue_flush", flush=kind, bucket=bucket, rows=total,
+                      backlog=self._pending_images)
         return bucket, take
 
     def poll(self, eager: bool = False):
@@ -244,6 +258,9 @@ class BatchingQueue:
             drained = list(self._pending)
             self._pending.clear()
             self._pending_images = 0
+            # the gauge must not freeze at the pre-stop backlog: the
+            # process-wide /metrics would report a phantom queue forever
+            obsm.SERVE_QUEUE_DEPTH.set(0)
             self._cond.notify_all()
         return drained
 

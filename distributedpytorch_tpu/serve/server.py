@@ -41,6 +41,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from distributedpytorch_tpu.obs import flight
 from distributedpytorch_tpu.serve.bucketing import stack_group
 from distributedpytorch_tpu.serve.engine import Replica, ServeEngine
 from distributedpytorch_tpu.serve.metrics import ServeMetrics
@@ -174,6 +175,7 @@ class Server:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._dispatch_error: Optional[BaseException] = None
+        self.config = None  # set by from_config; /healthz fingerprint
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "Server":
@@ -276,6 +278,9 @@ class Server:
         """Placement worker: claim a replica (backpressure), stack + pad
         to the bucket shape, H2D onto the replica's device."""
         bucket, reqs = payload
+        # placement-transition marker (ring slot only; dptlint's
+        # obs-hot-path/serve-hot-path rules keep anything blocking out)
+        flight.record("serve_place", bucket=bucket, reqs=len(reqs))
         replica = self._claim_replica()
         if replica is None:  # stopping — these were already popped from
             # the queue, so queue.stop() will never see them: resolve
@@ -328,6 +333,8 @@ class Server:
                 replica, x_dev, bucket, reqs = placed
                 try:
                     dispatch_t = self.clock()
+                    flight.record("serve_dispatch", bucket=bucket,
+                                  reqs=len(reqs))
                     out = self.engine.run(replica, x_dev)
                     self.metrics.record_dispatch(
                         bucket, sum(req.size for req in reqs)
@@ -351,6 +358,11 @@ class Server:
         except BaseException as exc:  # noqa: BLE001 — fail pending futures
             self._dispatch_error = exc
             logger.exception("serve dispatch loop died")
+            # the serving tier's post-mortem artifact: the ring's tail
+            # shows the flush/place/dispatch sequence that killed the loop
+            flight.dump("serve_dispatch_death",
+                        extra={"error": f"{type(exc).__name__}: "
+                                        f"{str(exc)[:200]}"})
             self._stop.set()  # ends _bucket_stream → the drain below is finite
             for req in self.queue.stop():
                 if not req.future.done():
@@ -413,7 +425,9 @@ class Server:
             inflight_per_replica=cfg.inflight_per_replica,
         )
         kwargs.update(overrides)
-        return cls(engine, **kwargs)
+        server = cls(engine, **kwargs)
+        server.config = cfg  # /healthz fingerprints the config it runs
+        return server
 
     # -- observability -------------------------------------------------------
     def stats(self) -> dict:

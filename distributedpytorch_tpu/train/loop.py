@@ -67,6 +67,8 @@ from distributedpytorch_tpu.data import (
     seeded_split,
 )
 from distributedpytorch_tpu.evaluate import evaluate, evaluate_sharded
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
 from distributedpytorch_tpu.ops.optim import get_learning_rate, set_learning_rate
 from distributedpytorch_tpu.ops.schedule import ReduceLROnPlateau
 from distributedpytorch_tpu.train.steps import create_train_state
@@ -112,11 +114,30 @@ class Trainer:
         # must eventually abort)
         self._rollback_budget = int(config.rollback_retries)
         self._skipped_steps = 0
-        # step-timeline tracer (utils/trace.py): disabled unless configured;
-        # main process only — co-row processes would interleave one file
-        self.tracer = StepTimeline(
-            config.timeline_path if self.strategy.is_main else None
-        )
+        # step-timeline tracer (utils/trace.py): JSONL off unless
+        # configured (spans still feed the flight recorder's ring). Every
+        # rank writes its OWN file — rank 0 the configured path, rank R
+        # `<path>.rankR` — so the trace hub (obs/trace_hub.py) can merge
+        # them into one rank-disambiguated Perfetto timeline instead of
+        # ranks interleaving torn lines into one file.
+        rank = jax.process_index()
+        timeline_path = config.timeline_path
+        if timeline_path and rank != 0:
+            timeline_path = f"{timeline_path}.rank{rank}"
+        self.tracer = StepTimeline(timeline_path, rank=rank)
+        # flight recorder (obs/flight.py): always-on ring; the dump path
+        # defaults under this run's log dir unless the caller/env chose
+        # one (bench_multi points it at the leg's artifact)
+        flight.set_rank(rank)
+        flight.set_default_dump_path(os.path.join(
+            config.log_dir, f"flight_{config.method_tag}_rank{rank}.json"
+        ))
+        # registry counters are process-lifetime; the host-cache gauge
+        # needs per-run deltas, so remember where this run started
+        self._cache_counted = (0, 0)
+        # on-demand device profile over a step range (--profile-steps)
+        self._profiling = False
+        self.metrics_server = None
         # ONE epoch-persistent decoded-sample cache shared by the train and
         # val loaders (they index the same dataset)
         self.sample_cache = (
@@ -417,6 +438,7 @@ class Trainer:
                 fut.result()  # raises if the write failed
             while len(self._ckpt_futures) > 2:
                 self._ckpt_futures.pop(0).result()
+        flight.record("phase", name="checkpoint", epoch=epoch)
         save_fn = (
             save_checkpoint_async
             if self.config.async_checkpoint
@@ -542,6 +564,9 @@ class Trainer:
             logger.error("rollback requested but no checkpoint at %s", path)
             return False
         self._rollback_budget -= 1
+        obsm.TRAIN_ROLLBACKS.inc()
+        flight.record("rollback", error=str(exc)[:200],
+                      retries_left=self._rollback_budget)
         logger.warning(
             "%s — rolling back to %s (%d retries left)",
             exc, path, self._rollback_budget,
@@ -563,11 +588,11 @@ class Trainer:
 
     def _watchdog_timeout(self) -> None:
         """StepWatchdog expiry (watchdog thread): dump the step-timeline
-        tracer's per-phase spans for diagnosis and request a
-        checkpoint-and-stop through the same collective agreement the
-        signal handler uses. Best-effort by nature — a host truly wedged
-        inside a native call cannot checkpoint; the dump is then the
-        run's last diagnostic."""
+        tracer's per-phase spans AND the flight recorder's ring for
+        diagnosis, then request a checkpoint-and-stop through the same
+        collective agreement the signal handler uses. Best-effort by
+        nature — a host truly wedged inside a native call cannot
+        checkpoint; the dumps are then the run's last diagnostic."""
         summary = {
             k: v for k, v in self.tracer.summary().items() if v is not None
         }
@@ -586,7 +611,53 @@ class Trainer:
                 "to capture per-phase spans for watchdog diagnosis"
             )
         self.tracer.flush()
+        # the post-mortem artifact: the ring's tail identifies the phase
+        # the loop wedged in (docs/OBSERVABILITY.md lifecycle)
+        flight.dump(
+            "watchdog_timeout",
+            extra={"step_timeout_s": self.config.step_timeout_s,
+                   "timeline_summary": summary},
+        )
         self._stop_requested = True
+
+    def _profile_tick(self, global_step: int) -> None:
+        """--profile-steps N:M — start the jax.profiler device trace
+        entering step N+1, stop once step M has run. Two integer
+        compares per iteration when armed; rank 0 only (one profile per
+        run, like the whole-run --profile-dir capture)."""
+        lo, hi = self.config.profile_steps
+        if not self._profiling and lo <= global_step < hi:
+            out = self.config.profile_dir or os.path.join(
+                self.config.log_dir, "profile"
+            )
+            logger.info(
+                "profiler: capturing device trace for steps [%d, %d) → %s",
+                lo, hi, out,
+            )
+            jax.profiler.start_trace(out)
+            self._profiling = True
+            flight.record("profile", action="start", step=global_step)
+        elif self._profiling and global_step >= hi:
+            jax.profiler.stop_trace()
+            self._profiling = False
+            flight.record("profile", action="stop", step=global_step)
+
+    def _update_cache_metrics(self) -> None:
+        """Epoch-boundary host-cache accounting: registry counters get
+        the per-run delta (they are process-lifetime), the gauge gets
+        the run's hit rate."""
+        if self.sample_cache is None:
+            return
+        hits, misses = self.sample_cache.hits, self.sample_cache.misses
+        h0, m0 = self._cache_counted
+        if hits > h0:
+            obsm.CACHE_HITS.inc(hits - h0)
+        if misses > m0:
+            obsm.CACHE_MISSES.inc(misses - m0)
+        self._cache_counted = (hits, misses)
+        total = hits + misses
+        if total:
+            obsm.CACHE_HIT_RATIO.set(hits / total)
 
     # ------------------------------------------------------------------
     def _record(self, loss, n_imgs: int, global_step: int, pbar) -> None:
@@ -617,6 +688,11 @@ class Trainer:
 
         def request_stop(signum, frame):
             self._stop_requested = True
+            # the preemption post-mortem: what the run was doing when the
+            # scheduler pulled the plug (dump is never-raises by contract)
+            flight.record("signal", signum=int(signum))
+            flight.dump("sigterm" if signum == signal.SIGTERM else
+                        f"signal_{int(signum)}")
             logger.info(
                 "Signal %d: will checkpoint and stop at the next step", signum
             )
@@ -683,6 +759,22 @@ class Trainer:
                 self.config.heartbeat_interval_s,
             ).start()
             self._heartbeat.update(self.start_epoch, int(self.state.step))
+        if self.config.metrics_port is not None:
+            from distributedpytorch_tpu.obs.http import (
+                build_fingerprint,
+                start_metrics_server,
+            )
+
+            # rank R binds port+R so every rank of a multi-process job is
+            # its own scrape target; port 0 stays 0 (ephemeral — tests)
+            port = self.config.metrics_port
+            if port:
+                port += jax.process_index()
+            self.metrics_server = start_metrics_server(
+                port, fingerprint=build_fingerprint(self.config)
+            )
+            logger.info("metrics: serving /metrics on port %d",
+                        self.metrics_server.port)
         ok = False
         try:
             result = self._run()
@@ -692,6 +784,13 @@ class Trainer:
             self._restore_signal_handler()
             if getattr(self, "_watchdog", None) is not None:
                 self._watchdog.stop()
+            if self._profiling:  # run ended inside the --profile-steps range
+                try:
+                    jax.profiler.stop_trace()
+                finally:
+                    self._profiling = False
+            if self.metrics_server is not None:
+                self.metrics_server.close()
             try:
                 # flush BEFORE draining checkpoints: a failed write
                 # raises out of the drain, and the final epoch's
@@ -725,7 +824,14 @@ class Trainer:
             get_learning_rate(self.state.opt_state),
             len(self.train_loader),
         )
-        if cfg.profile_dir and self.strategy.is_main:
+        # whole-run capture only when no step range was asked for — the
+        # two would race one another's start/stop on the same profiler
+        whole_run_profile = (
+            cfg.profile_dir and cfg.profile_steps is None
+            and self.strategy.is_main
+        )
+        profile_by_steps = cfg.profile_steps is not None and self.strategy.is_main
+        if whole_run_profile:
             jax.profiler.start_trace(cfg.profile_dir)
 
         from tqdm import tqdm
@@ -786,6 +892,7 @@ class Trainer:
                         if skip_guard and not self._finite_agreed(loss):
                             # the one host sync per step this policy costs
                             self._skipped_steps += 1
+                            obsm.TRAIN_SKIPPED_STEPS.inc()
                             logger.warning(
                                 "non-finite loss at step %d: update "
                                 "discarded (%d skipped so far)",
@@ -882,8 +989,22 @@ class Trainer:
                     # group's drained singles) are simply never stepped:
                     # they were never trained, so skipping them loses
                     # nothing, and a preemption grace window may be ticking.
+                    flight.record("phase", name="epoch_start", epoch=epoch,
+                                  step=global_step)
+                    # host-observed step cadence → the step-time histogram
+                    # (a perf_counter read + one bounded observe per
+                    # iteration; no device sync)
+                    iter_t0 = None
                     with contextlib.closing(source):
                         for (kind, payload), placed in source:
+                            now_t = time.perf_counter()
+                            if iter_t0 is not None:
+                                obsm.TRAIN_STEP_SECONDS.observe(
+                                    now_t - iter_t0
+                                )
+                            iter_t0 = now_t
+                            if profile_by_steps:
+                                self._profile_tick(global_step)
                             if self._heartbeat is not None:
                                 # attribute assignments only — the beat
                                 # FILE is written by the heartbeat's own
@@ -974,6 +1095,8 @@ class Trainer:
                     )
                     break
 
+                flight.record("phase", name="eval", epoch=epoch,
+                              step=global_step)
                 if self.grouped_eval_step is not None:
                     val_loss, val_dice = evaluate_sharded(
                         self.eval_step,
@@ -1010,6 +1133,7 @@ class Trainer:
                 )
                 # append this epoch's timeline spans (no-op when tracing is off)
                 self.tracer.flush()
+                self._update_cache_metrics()
                 # no is_main gate: val_dice is identical on every rank, so
                 # all ranks take this branch together — the payload build
                 # inside _save_tagged is collective on sharded state, and
@@ -1057,13 +1181,18 @@ class Trainer:
                 if watchdog is not None:
                     watchdog.pause()
                 if not self._try_rollback(exc):
+                    # terminal non-finite abort (policy 'abort', or
+                    # 'rollback' with its budget spent): ship the
+                    # post-mortem before unwinding
+                    flight.dump("nonfinite_abort",
+                                extra={"error": str(exc)[:200]})
                     raise
                 epoch = self.start_epoch  # _restore rewound it
                 global_step = int(self.state.step)
                 continue
             epoch += 1
 
-        if cfg.profile_dir and self.strategy.is_main:
+        if whole_run_profile:
             jax.profiler.stop_trace()
 
         if not self._stop_requested and not stopped_early:

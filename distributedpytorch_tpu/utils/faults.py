@@ -35,6 +35,8 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Sequence, Tuple
 
+from distributedpytorch_tpu.obs import flight
+
 logger = logging.getLogger(__name__)
 
 #: The named injection sites (one per recovery path under test).
@@ -194,6 +196,9 @@ class FaultInjector:
                     "fault injection: firing %r at epoch=%s step=%s",
                     site, epoch, step,
                 )
+                # the flight recorder's post-mortem tail must show the
+                # injected fault next to the phase it killed
+                flight.record("fault", site=site, epoch=epoch, step=step)
                 return True
         return False
 
@@ -259,6 +264,11 @@ def call_with_retries(
             if not is_transient(exc) or attempt >= retries:
                 raise
             delay = backoff_s * (2.0 ** attempt)
+            from distributedpytorch_tpu.obs import defs as obsm
+
+            obsm.TRAIN_RETRIES.labels(site=site).inc()
+            flight.record("retry", site=site, attempt=attempt + 1,
+                          error=f"{type(exc).__name__}: {str(exc)[:120]}")
             (log or logger).warning(
                 "transient %s failure (attempt %d/%d): %s — retrying in %.2gs",
                 site, attempt + 1, retries, exc, delay,

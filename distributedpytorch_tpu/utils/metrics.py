@@ -32,6 +32,7 @@ from typing import List, Optional
 
 import numpy as np
 
+from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.utils.trace import NULL_TIMELINE
 
 
@@ -92,6 +93,9 @@ class LossRecords:
         window old) and parks its own window for the next boundary."""
         self.losses.append(loss)
         self.images_seen += batch_images
+        obsm.TRAIN_STEPS.inc()
+        if batch_images:
+            obsm.TRAIN_IMAGES.inc(batch_images)
         if self._steady_t0 is None:
             # step 1 just ran (its dispatch included the jit trace+compile):
             # start the steady-state clock here and don't count its images
@@ -122,6 +126,9 @@ class LossRecords:
                 ]
                 self.losses[lo:hi] = window
                 self.train_rows.append([step, ts, float(np.mean(window))])
+                # telemetry rides the drain the pipeline already does —
+                # the one place a train-loss value is a host float for free
+                obsm.TRAIN_LOSS.set(self.train_rows[-1][2])
                 if self.nonfinite_hook is not None:
                     for v in window:
                         if not np.isfinite(v):
@@ -181,6 +188,10 @@ class LossRecords:
         self.val_rows.append([step, now, float(val_loss)])
         if val_dice is not None:
             self.dice_rows.append([step, now, float(val_dice)])
+        obsm.TRAIN_VAL_LOSS.set(float(val_loss))
+        if val_dice is not None:
+            obsm.TRAIN_VAL_DICE.set(float(val_dice))
+        obsm.TRAIN_IMGS_PER_S.set(self.images_per_second())
 
     @property
     def elapsed(self) -> float:

@@ -19,6 +19,15 @@ grew. `bench.py` emits the summary alongside imgs/sec; the overlap test
 
 Disabled (the default: no path) it is a no-op cheap enough to leave the
 call sites unconditional.
+
+The telemetry layer (distributedpytorch_tpu/obs) rides these call
+sites: every completed span ALSO lands in the flight recorder's bounded
+ring (obs/flight.py) whether JSONL tracing is on or not — that is what
+makes a crash dump's tail identify the phase a dead run was in — and
+events carry a ``rank`` tag plus a wall-clock anchor so the trace hub
+(obs/trace_hub.py) can merge per-rank JSONL files into one Perfetto
+timeline with cross-rank-comparable timestamps (``t0``/``t1`` stay
+``perf_counter`` values, whose origin is per-process).
 """
 
 from __future__ import annotations
@@ -29,6 +38,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional
 
+from distributedpytorch_tpu.obs import flight
+
 PHASES = ("decode", "stack", "h2d", "dispatch", "readback")
 
 
@@ -37,11 +48,15 @@ class StepTimeline:
 
     ``path=None`` disables collection entirely unless ``enabled=True`` is
     forced (in-memory mode — what bench.py uses for its inline summary).
+    Even disabled, completed spans feed the flight recorder's ring
+    (bounded, allocation = the ring slot) unless ``DPT_OBS=0``.
     """
 
-    def __init__(self, path: Optional[str] = None, *, enabled: Optional[bool] = None):
+    def __init__(self, path: Optional[str] = None, *,
+                 enabled: Optional[bool] = None, rank: int = 0):
         self.path = path
         self.enabled = (path is not None) if enabled is None else enabled
+        self.rank = int(rank)
         self._events: List[dict] = []
         self._lock = threading.Lock()
         # per-phase running totals survive flush(): the summary covers the
@@ -49,9 +64,11 @@ class StepTimeline:
         self._totals: Dict[str, List[float]] = {}  # phase -> [count, total_s]
 
     def record(self, phase: str, t0: float, t1: float, **tags) -> None:
+        flight.record_span(phase, t0, t1, rank=self.rank, **tags)
         if not self.enabled:
             return
-        event = {"phase": phase, "t0": round(t0, 6), "t1": round(t1, 6), **tags}
+        event = {"phase": phase, "t0": round(t0, 6), "t1": round(t1, 6),
+                 "wall": round(time.time(), 6), "rank": self.rank, **tags}
         with self._lock:
             self._events.append(event)
             acc = self._totals.setdefault(phase, [0, 0.0])
@@ -60,7 +77,7 @@ class StepTimeline:
 
     @contextlib.contextmanager
     def span(self, phase: str, **tags):
-        if not self.enabled:
+        if not self.enabled and not flight.get().enabled:
             yield
             return
         t0 = time.perf_counter()

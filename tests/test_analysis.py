@@ -426,6 +426,121 @@ class TestLintRules:
 
 
 # ---------------------------------------------------------------------------
+class TestObsHotPathRule:
+    """The telemetry layer's hot-path contract (ISSUE 7): obs record
+    paths never block or grow without bound, and telemetry calls never
+    land inside traced functions (docs/ANALYSIS.md row, docs/
+    OBSERVABILITY.md contract)."""
+
+    OBS_PATH = "distributedpytorch_tpu/obs/x.py"
+
+    def test_blocking_sync_in_record_path_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "class R:\n"
+            "    def record(self, x):\n"
+            "        return np.asarray(x)\n"
+        )
+        findings = lint.lint_source(src, self.OBS_PATH)
+        assert "obs-hot-path" in [f.rule for f in findings]
+
+    def test_unbounded_append_in_record_path_flagged(self):
+        src = (
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._events = []\n"
+            "    def record(self, x):\n"
+            "        self._events.append(x)\n"
+        )
+        findings = lint.lint_source(src, self.OBS_PATH)
+        assert [f.rule for f in findings] == ["obs-hot-path"]
+        assert "deque(maxlen" in findings[0].message
+
+    def test_deque_maxlen_ring_append_is_sanctioned(self):
+        src = (
+            "import collections\n"
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._events = collections.deque(maxlen=8)\n"
+            "    def record(self, x):\n"
+            "        self._events.append(x)\n"
+        )
+        assert lint.lint_source(src, self.OBS_PATH) == []
+
+    def test_annotated_deque_assignment_is_recognized(self):
+        # flight.py's own idiom: an AnnAssign-constructed ring
+        src = (
+            "import collections\n"
+            "class R:\n"
+            "    def __init__(self):\n"
+            "        self._events: collections.deque = "
+            "collections.deque(maxlen=8)\n"
+            "    def record_span(self, x):\n"
+            "        self._events.append(x)\n"
+        )
+        assert lint.lint_source(src, self.OBS_PATH) == []
+
+    def test_append_outside_record_path_not_flagged(self):
+        src = (
+            "class R:\n"
+            "    def expose(self):\n"
+            "        lines = []\n"
+            "        lines.append('x')\n"
+            "        return lines\n"
+        )
+        assert lint.lint_source(src, self.OBS_PATH) == []
+
+    def test_append_outside_obs_module_not_flagged(self):
+        src = (
+            "class R:\n"
+            "    def record(self, x):\n"
+            "        self._events.append(x)\n"
+        )
+        assert lint.lint_source(src, "pkg/serve/x.py") == []
+
+    def test_obs_call_inside_traced_function_flagged(self):
+        src = (
+            "import jax\n"
+            "from distributedpytorch_tpu.obs import flight\n"
+            "from distributedpytorch_tpu.obs import defs as obsm\n"
+            "def make_step():\n"
+            "    def step(s, b):\n"
+            "        flight.record('step', step=1)\n"
+            "        obsm.TRAIN_STEPS.inc()\n"
+            "        return s\n"
+            "    return jax.jit(step)\n"
+        )
+        findings = [
+            f for f in lint.lint_source(src, "pkg/train/x.py")
+            if f.rule == "obs-hot-path"
+        ]
+        assert len(findings) == 2
+        assert all("trace time" in f.message for f in findings)
+
+    def test_obs_call_on_host_loop_is_fine(self):
+        src = (
+            "from distributedpytorch_tpu.obs import flight\n"
+            "def train_loop(batches):\n"
+            "    for b in batches:\n"
+            "        flight.record('step')\n"
+        )
+        assert lint.lint_source(src, "pkg/train/x.py") == []
+
+    def test_shipped_obs_package_is_clean(self):
+        import distributedpytorch_tpu.obs as obs_pkg
+
+        root = os.path.dirname(obs_pkg.__file__)
+        for fname in sorted(os.listdir(root)):
+            if not fname.endswith(".py"):
+                continue
+            findings = lint.lint_file(
+                os.path.join(root, fname),
+                root=os.path.dirname(os.path.dirname(root)),
+            )
+            assert findings == [], (fname, findings)
+
+
+# ---------------------------------------------------------------------------
 class TestServeHotPathRule:
     """The serve-tier twin of host-sync-hot-path (ISSUE 6): blocking
     host syncs inside the serve dispatch pipeline (serve/server.py's

@@ -467,6 +467,74 @@ class TestServeBenchConfig:
         assert bench_multi.load_state(out) == {"serve_bench": "ok"}
 
 
+class TestFlightArtifacts:
+    """ISSUE 7: every leg's result row names its flight-recorder
+    artifact path, and a poisoned/dead-probe leg dumps the ring buffer
+    at mark time — a dead chip-window leg ships its own post-mortem."""
+
+    _fake_bench = TestMainLoop._fake_bench
+    _patch = TestMainLoop._patch
+
+    def test_result_rows_record_artifact_path(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0)]
+        mod = self._fake_bench([{"value": 1.0}])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        assert bench_multi.main(["--out", out]) == 0
+        rows = [d for d in _lines(out) if d.get("config") == "a"
+                and "error" not in d and d.get("event") is None]
+        assert rows and rows[0]["flight_recorder"] == (
+            bench_multi.flight_artifact_path(out, "a")
+        )
+
+    def test_injected_probe_death_dumps_parseable_artifact(
+            self, tmp_path, monkeypatch):
+        """Dead probe at session start (rc=2) ⇒ the ring is dumped and
+        the session_end line references an artifact that parses."""
+        from distributedpytorch_tpu.obs import flight
+
+        flight.record("span", phase="dispatch", step=3)
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0)]
+        mod = self._fake_bench([])
+        self._patch(monkeypatch, tmp_path, False, mod, configs)
+        assert bench_multi.main(["--out", out]) == 2
+        end = [d for d in _lines(out) if d.get("event") == "session_end"][-1]
+        artifact = end["flight_recorder"]
+        assert artifact == bench_multi.flight_artifact_path(out, "session")
+        d = json.load(open(artifact))
+        assert d["reason"] == "dead_probe_at_start"
+        assert d["extra"]["probe"]["ok"] is False
+        assert any(e.get("phase") == "dispatch" for e in d["events"])
+
+    def test_config_error_dumps_and_references_artifact(
+            self, tmp_path, monkeypatch):
+        from distributedpytorch_tpu.obs import flight
+
+        flight.get().clear()
+        out = str(tmp_path / "m.jsonl")
+        configs = [("a", {}, 60.0)]
+        mod = self._fake_bench([ValueError("deterministically broken")])
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        assert bench_multi.main(["--out", out]) == 0
+        row = [d for d in _lines(out)
+               if d.get("config") == "a" and "error" in d][0]
+        assert row["error"].startswith("config_error")
+        d = json.load(open(row["flight_recorder"]))
+        assert d["reason"].startswith("config_error")
+
+    def test_wedged_previous_attempt_line_references_artifact(
+            self, tmp_path):
+        out = str(tmp_path / "m.jsonl")
+        _write(out, [{"event": "attempting", "config": "a"}])
+        state = bench_multi.load_state(out)
+        assert state == {"a": "poison"}
+        line = [d for d in _lines(out) if d.get("error")][-1]
+        assert line["flight_recorder"] == (
+            bench_multi.flight_artifact_path(out, "a")
+        )
+
+
 class TestSupervisorRestarts:
     """Window reports carry the elastic supervisor's restart count, so a
     flapping chip window (job survived via relaunches) reads differently

@@ -88,6 +88,59 @@ class TestWorkerArgPlumbing:
         assert "--xla_force_host_platform_device_count=2" in env["XLA_FLAGS"]
         assert env["DPT_DIST_INIT_TIMEOUT_S"]
 
+    def test_trace_timeline_armed_by_default_and_disableable(
+        self, tmp_path
+    ):
+        # ISSUE 7: every elastic attempt arms per-rank step timelines so
+        # a dead attempt leaves a mergeable Perfetto post-mortem
+        sup = ElasticSupervisor(
+            ["-t", "DDP"], nprocs=2, run_dir=str(tmp_path / "run"),
+        )
+        argv = sup._worker_argv(0)
+        i = argv.index("--trace-timeline")
+        assert argv[i + 1] == sup._timeline_base(0)
+        assert "attempt0" in argv[i + 1]
+        off = ElasticSupervisor(
+            ["-t", "DDP"], nprocs=2, run_dir=str(tmp_path / "run"),
+            trace=False,
+        )
+        assert "--trace-timeline" not in off._worker_argv(0)
+
+    def test_worker_env_routes_flight_dumps_to_attempt_dir(self, tmp_path):
+        sup = ElasticSupervisor(
+            [], nprocs=2, run_dir=str(tmp_path / "run"), cpu_devices=2
+        )
+        env = sup._worker_env(rank=1, world=2, port=1, attempt=3)
+        assert env["DPT_FLIGHT_DIR"] == os.path.join(
+            sup.run_dir, "attempt3"
+        )
+
+    def test_merge_timelines_builds_rank_disambiguated_trace(
+        self, tmp_path
+    ):
+        from distributedpytorch_tpu.utils.trace import StepTimeline
+
+        sup = ElasticSupervisor(
+            [], nprocs=2, run_dir=str(tmp_path / "run"),
+        )
+        sup.world_history = [2]  # one attempt happened
+        base = sup._timeline_base(0)
+        os.makedirs(os.path.dirname(base), exist_ok=True)
+        for rank in (0, 1):
+            path = base if rank == 0 else f"{base}.rank{rank}"
+            tl = StepTimeline(path, rank=rank)
+            tl.record("dispatch", 1.0, 1.5, step=rank)
+            tl.flush()
+        out = sup._merge_timelines()
+        assert out == os.path.join(sup.run_dir, "timeline_merged.json")
+        trace = json.load(open(out))
+        events = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert {e["pid"] for e in events} == {0, 1}
+        # and the report JSON references the merged artifact
+        sup._write_report(final="ok")
+        report = json.load(open(sup.report_path))
+        assert report["merged_timeline"] == out
+
     def test_supervisor_module_is_jax_free(self):
         """The supervisor process must never initialize a backend (or
         dial a tunneled runtime): no jax import anywhere in elastic.py."""

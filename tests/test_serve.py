@@ -348,6 +348,11 @@ class TestHTTP:
             health = json.loads(conn.getresponse().read())
             assert health["status"] == "ok"
             assert health["buckets"] == [1, 2, 4]
+            # telemetry-layer additions (ISSUE 7): uptime + the
+            # build/config fingerprint a post-incident reader reproduces
+            # the numbers with
+            assert health["uptime_s"] >= 0
+            assert health["fingerprint"]["version"]
 
             with open(_image_files(images_dir)[0], "rb") as f:
                 body = f.read()
@@ -364,10 +369,81 @@ class TestHTTP:
             conn.request("GET", "/stats")
             stats = json.loads(conn.getresponse().read())
             assert stats["requests_ok"] >= 1
+
+            # GET /metrics: valid Prometheus exposition covering the
+            # serve families (acceptance criterion — the serve front IS
+            # a scrape target now)
+            from distributedpytorch_tpu.obs import validate_exposition
+
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type").startswith("text/plain")
+            types = validate_exposition(resp.read().decode())
+            assert any(k.startswith("dpt_serve_") for k in types)
+            assert any(k.startswith("dpt_train_") for k in types)
+            assert any(k.startswith("dpt_elastic_") for k in types)
             conn.close()
         finally:
             httpd.shutdown()
             server.stop()
+
+
+class TestStatsSchema:
+    """The /stats JSON schema is a PINNED contract: ServeMetrics moved
+    onto the shared telemetry registry (ISSUE 7) and dashboards/load
+    generators parse these exact keys — a migration that renamed or
+    retyped one would break them silently."""
+
+    STATS_KEYS = {
+        "requests_ok", "requests_failed", "rejected", "rejected_total",
+        "images_ok", "elapsed_s", "imgs_per_s", "p50_ms", "p99_ms",
+        "queue_p50_ms", "bucket_dispatches", "pad_ratio",
+        # Server.stats() additions on top of the snapshot
+        "queue_depth_images", "queue_max_depth_images",
+        "queue_hard_cap_images", "replicas", "buckets",
+    }
+
+    def test_stats_key_set_and_types_pinned(self, engine):
+        from distributedpytorch_tpu.serve.server import Server
+
+        server = Server(engine).start()
+        try:
+            resp = server.submit(
+                np.zeros((32, 48, 3), np.float32)
+            ).result(30)
+            assert resp.ok
+            stats = server.stats()
+            assert set(stats) == self.STATS_KEYS
+            assert isinstance(stats["requests_ok"], int)
+            assert isinstance(stats["rejected"], dict)
+            assert isinstance(stats["bucket_dispatches"], dict)
+            assert isinstance(stats["imgs_per_s"], float)
+            assert stats["requests_ok"] == 1
+            assert stats["images_ok"] == 1
+            json.dumps(stats)  # JSON-serializable end to end
+        finally:
+            server.stop()
+
+    def test_snapshot_counters_are_per_server_not_process(self, engine):
+        """Two servers in one process: the registry accumulates across
+        both (Prometheus semantics) but each /stats starts at zero —
+        the byte-compat guarantee of the migration."""
+        from distributedpytorch_tpu.serve.server import Server
+
+        first = Server(engine).start()
+        try:
+            assert first.submit(
+                np.zeros((32, 48, 3), np.float32)
+            ).result(30).ok
+        finally:
+            first.stop()
+        second = Server(engine).start()
+        try:
+            assert second.stats()["requests_ok"] == 0
+            assert second.stats()["images_ok"] == 0
+        finally:
+            second.stop()
 
 
 class TestBenchServe:

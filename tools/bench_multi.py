@@ -51,6 +51,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from distributedpytorch_tpu.obs import flight  # noqa: E402 — stdlib-only
+
 # (name, env overrides, per-config watchdog seconds). Order is the
 # safety story (see module docstring): pixel's compile class already
 # succeeded on this channel in round 3, b8 is the default graph at a
@@ -146,6 +148,17 @@ def _is_channel_error(exc) -> bool:
     return any(m in msg for m in _CHANNEL_MARKERS)
 
 
+def flight_artifact_path(out_path: str, name: str) -> str:
+    """Deterministic flight-recorder artifact path for one config, next
+    to the session artifact: the poison line of a leg whose process DIED
+    (load_state's wedged_previous_attempt mark, stamped by the NEXT
+    invocation) must be able to reference the artifact the dead process
+    dumped without re-deriving anything."""
+    return os.path.join(
+        os.path.dirname(os.path.abspath(out_path)), f"flight_{name}.json"
+    )
+
+
 def append_line(path: str, obj: dict) -> None:
     obj = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **obj}
     with open(path, "a") as f:
@@ -212,6 +225,9 @@ def load_state(path: str) -> dict:
             "config": attempting,
             "error": "wedged_previous_attempt: process died mid-config "
                      "(killed or crashed during compile/measure)",
+            # the dead process's post-mortem, if its watchdog/excepthook
+            # managed to dump one before the end (obs/flight.py)
+            "flight_recorder": flight_artifact_path(path, attempting),
         })
         state[attempting] = "poison"
     return state
@@ -296,10 +312,19 @@ def _arm_config_watchdog(path: str, name: str, secs: float):
     """A wedged runtime hangs inside a native call no exception escapes;
     only a timer thread + hard exit gets an attribution line written."""
     def fire():
+        # dump the flight ring FIRST: the poison line ships its own
+        # post-mortem (the ring's tail says which phase wedged), so a
+        # dead chip-window leg is attributable without a rerun
+        artifact = flight.dump(
+            f"bench_watchdog: {name}",
+            path=flight_artifact_path(path, name),
+            extra={"budget_s": secs},
+        )
         append_line(path, {
             "config": name,
             "error": f"watchdog: no result after {secs:.0f}s "
                      "(compile wedged or runtime died mid-config)",
+            "flight_recorder": artifact,
         })
         sys.stdout.flush()
         os._exit(3)
@@ -395,8 +420,15 @@ def main(argv=None) -> int:
                            "supervisor_restarts": supervisor_restarts()})
     if not probe.get("ok"):
         print(f"bench_multi: runtime dead at start: {probe}")
+        # dead-probe post-mortem: whatever the probe path recorded
+        artifact = flight.dump(
+            "dead_probe_at_start",
+            path=flight_artifact_path(args.out, "session"),
+            extra={"probe": probe},
+        )
         append_line(args.out, {
             "event": "session_end", "rc": 2,
+            "flight_recorder": artifact,
             "supervisor_restarts": supervisor_restarts(),
         })
         return 2
@@ -406,11 +438,23 @@ def main(argv=None) -> int:
     # env hygiene is per-config now: _run_one snapshots and restores the
     # ambient values of every key it touches, so no process-wide cleanup
     # (the old unconditional pop destroyed caller-set levers) is needed.
+    # The flight dump path IS process state — restore it on every exit
+    # so an embedding process (tests, a watcher) keeps its own routing.
+    try:
+        return _run_configs(args, todo, bench, _probe_once)
+    finally:
+        flight.set_dump_path(None)
+
+
+def _run_configs(args, todo, bench, _probe_once) -> int:
     for name, env, budget in todo:
         # static preflight BEFORE the attempting marker and the watchdog:
         # a poison-marked config consumes none of the session budget
         if not _static_preflight(name, env, args.out):
             continue
+        # route this leg's flight-recorder dumps (watchdog, trainer
+        # aborts inside the bench, excepthook) to its own artifact
+        flight.set_dump_path(flight_artifact_path(args.out, name))
         append_line(args.out, {"event": "attempting", "config": name,
                                "budget_s": budget})
         dog = _arm_config_watchdog(args.out, name, budget)
@@ -451,9 +495,14 @@ def main(argv=None) -> int:
                     print(f"bench_multi: channel blip at config "
                           f"{name!r} (runtime alive): {exc}")
                     continue
+                artifact = flight.dump(
+                    f"config_error: {name}",
+                    extra={"error": f"{type(exc).__name__}: {str(exc)[:300]}"},
+                )
                 append_line(args.out, {
                     "config": name,
                     "error": f"config_error: {type(exc).__name__}: {exc}",
+                    "flight_recorder": artifact,
                 })
                 print(f"bench_multi: deterministic failure in {name!r}: "
                       f"{exc}")
@@ -476,7 +525,13 @@ def main(argv=None) -> int:
             })
             return 4
         dog.cancel()
-        append_line(args.out, {"config": name, **result})
+        # every leg's row names its flight-recorder artifact path — the
+        # file exists iff something on the leg dumped (watchdog, abort,
+        # excepthook); a healthy leg's path simply has nothing at it
+        append_line(args.out, {
+            "config": name, **result,
+            "flight_recorder": flight_artifact_path(args.out, name),
+        })
         print(json.dumps({"config": name, **result}))
         sys.stdout.flush()
 

@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""CI smoke: a 2-step training run serves GET /metrics, and the
+exposition passes the strict Prometheus format checker.
+
+The tier-1 suite covers the same surface in-process
+(tests/test_obs.py::TestTrainingMetricsEndpoint); this script is the
+curl-shaped end-to-end — an ephemeral ``--metrics-port`` training run
+scraped over real HTTP while it trains, validated with
+``obs.validate_exposition``, asserting the train/serve/supervisor
+families are all present. Exits nonzero on any violation.
+
+Usage: python tools/metrics_smoke.py  (CPU, no data, ~1 min cold)
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.obs import validate_exposition
+    from distributedpytorch_tpu.train import Trainer
+
+    tmp = tempfile.mkdtemp(prefix="dpt_metrics_smoke_")
+    cfg = TrainConfig(
+        train_method="singleGPU",
+        epochs=1,
+        batch_size=8,
+        learning_rate=3e-4,
+        val_percent=25.0,
+        compute_dtype="float32",
+        image_size=(48, 32),
+        model_widths=(8, 16),
+        synthetic_samples=16,  # 2 train steps minus the dropped tail
+        checkpoint_dir=os.path.join(tmp, "ckpt"),
+        log_dir=os.path.join(tmp, "logs"),
+        loss_dir=os.path.join(tmp, "loss"),
+        num_workers=0,
+        metric_every_steps=1,
+        metrics_port=0,  # ephemeral; read back below
+    )
+    trainer = Trainer(cfg)
+    errors = []
+    done = threading.Event()
+
+    def run():
+        try:
+            trainer.train()
+        except Exception as exc:  # noqa: BLE001 — reported below
+            errors.append(exc)
+        finally:
+            done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    deadline = time.monotonic() + 300
+    while trainer.metrics_server is None:
+        if errors:
+            raise SystemExit(f"training failed before serving: {errors[0]}")
+        if time.monotonic() > deadline:
+            raise SystemExit("metrics server never came up")
+        time.sleep(0.05)
+    port = trainer.metrics_server.port
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=60
+    ).read().decode()
+    families = validate_exposition(text)
+    for prefix in ("dpt_train_", "dpt_serve_", "dpt_elastic_"):
+        if not any(k.startswith(prefix) for k in families):
+            raise SystemExit(f"no {prefix}* family in /metrics")
+    health = json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=60
+    ).read())
+    if health["status"] != "ok" or "config_sha" not in health["fingerprint"]:
+        raise SystemExit(f"bad /healthz: {health}")
+    done.wait(timeout=300)
+    if errors:
+        raise SystemExit(f"training run failed: {errors[0]}")
+    print(f"metrics smoke OK: {len(families)} families, "
+          f"fingerprint {health['fingerprint']['config_sha']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
