@@ -42,6 +42,24 @@ with rationale and what each provably excludes: docs/ANALYSIS.md):
   unmatched one. (The jaxpr layer proves the same property dynamically
   via dual-rank tracing; this rule points at the exact source line.)
 
+* ``dtype-policy`` — the mixed-precision policy's cast-boundary contract
+  (ops/precision.py, docs/PERFORMANCE.md "Precision"): a bare
+  ``jnp.float32``/``np.float32`` literal (or ``astype("float32")``)
+  inside a traced function is an upcast the ``--dtype`` policies cannot
+  see — under bf16 it silently re-widens a hot-path tensor, under
+  bf16_params it forks the param dtype mid-trace. Sanctioned seams spell
+  the contract by NAME (``precision.LOSS_DTYPE`` / ``WGRAD_DTYPE`` /
+  ``REDUCE_DTYPE``) or live in the sanctioned modules (the loss/kernel
+  families whose f32 accumulation IS the policy).
+
+* ``ckpt-dtype-drift`` — donation-aware save/restore dtype drift: a
+  ``load_checkpoint``/``load_weights`` call whose enclosing function
+  never routes the result through the policy's restore seams
+  (``ensure_restored_dtypes`` / ``convert_checkpoint_state``) can hand
+  the step params whose dtype differs from the session policy — the
+  jitted step would silently RETRACE against the drifted layout (and its
+  donated buffers), instead of re-casting loudly or failing.
+
 * ``obs-hot-path`` — the telemetry layer's hot-path contract
   (distributedpytorch_tpu/obs, docs/OBSERVABILITY.md): (a) record paths
   inside ``obs/`` (functions named ``record*``/``inc``/``observe``/
@@ -148,6 +166,39 @@ SERVE_SANCTIONED_DRAIN_FNS = frozenset({"pull"})
 #: tx) and donate nothing.
 DONATING_CALLS = frozenset({"train_step", "multi_step", "accum_step"})
 
+
+#: Bare f32 dtype spellings (rule ``dtype-policy``): inside a traced
+#: function these are accidental upcasts the --dtype policy cannot see;
+#: the sanctioned spellings are the named contract constants
+#: (precision.LOSS_DTYPE / WGRAD_DTYPE / REDUCE_DTYPE).
+F32_LITERAL_DOTTED = frozenset({
+    "jnp.float32", "jax.numpy.float32", "np.float32", "numpy.float32",
+})
+#: Modules whose f32 literals ARE the policy: the precision module
+#: itself, the loss family (f32 loss/stats is the LOSS_DTYPE contract's
+#: implementation), and the hand-written kernels whose f32 VMEM
+#: accumulators are load-bearing numerics, not policy drift.
+DTYPE_POLICY_SANCTIONED_MODULES = (
+    os.path.join("ops", "precision.py"),
+    os.path.join("ops", "losses.py"),
+    os.path.join("ops", "fused_loss.py"),
+    os.path.join("ops", "quant.py"),
+    os.path.join("ops", "pallas_kernels.py"),
+    os.path.join("ops", "wgrad_pallas.py"),
+    os.path.join("ops", "conv_backward.py"),
+    os.path.join("ops", "s2d.py"),
+)
+
+#: Checkpoint-restore entry points (rule ``ckpt-dtype-drift``) and the
+#: precision-policy seams their enclosing function must route through.
+CKPT_RESTORE_CALLS = frozenset({"load_checkpoint", "load_weights"})
+CKPT_RESTORE_SEAMS = frozenset({
+    "ensure_restored_dtypes", "convert_checkpoint_state",
+})
+#: checkpoint.py defines the loaders (its internal format dispatch calls
+#: load_checkpoint without a session policy in scope — the seam is its
+#: CALLERS' obligation).
+CKPT_RULE_EXEMPT_MODULES = ("checkpoint.py",)
 
 #: The obs record-path scope (rule ``obs-hot-path``): functions with
 #: these names (or any ``record*``) inside ``obs/`` modules are the
@@ -380,6 +431,12 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
         ))
 
     in_obs_module = _is_obs_module(rel_path)
+    dtype_sanctioned_file = any(
+        rel_path.endswith(sfx) for sfx in DTYPE_POLICY_SANCTIONED_MODULES
+    )
+    ckpt_rule_exempt_file = any(
+        rel_path.endswith(sfx) for sfx in CKPT_RULE_EXEMPT_MODULES
+    )
     bounded_appends = _bounded_append_targets(tree) if in_obs_module else set()
     in_hot_file = any(rel_path.endswith(sfx) for sfx, _fn in HOT_PATH_SCOPES)
     hot_fn_names = {fn for sfx, fn in HOT_PATH_SCOPES
@@ -500,6 +557,30 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                     f"`deque(maxlen=...)`",
                 )
 
+        # -- dtype-policy (b): astype("float32") / dtype="float32" string
+        # spellings in traced code — same hazard as the dotted literal
+        # form handled in the node walk below
+        if traced and not dtype_sanctioned_file:
+            string_f32 = (
+                term == "astype"
+                and len(node.args) == 1
+                and isinstance(node.args[0], ast.Constant)
+                and node.args[0].value == "float32"
+            ) or any(
+                kw.arg == "dtype"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value == "float32"
+                for kw in node.keywords
+            )
+            if string_f32:
+                emit(
+                    "dtype-policy", node,
+                    "bare \"float32\" dtype inside a traced function is an "
+                    "upcast the --dtype policy cannot see — spell the "
+                    "contract (precision.LOSS_DTYPE / WGRAD_DTYPE / "
+                    "REDUCE_DTYPE) or thread the policy",
+                )
+
         # -- obs-hot-path (b): telemetry calls inside traced functions
         # execute ONCE at trace time — the metric/event silently never
         # records (and a constant side effect bakes into the program)
@@ -512,6 +593,61 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                 f"once at trace time and never again — record from the "
                 f"host loop (or a drain) instead",
             )
+
+    # -- dtype-policy (a): bare jnp.float32/np.float32 literal loads in
+    # traced functions — the accidental-upcast form (an astype arg, a
+    # zeros/full dtype operand). The sanctioned spelling is the named
+    # precision constant; the sanctioned modules implement the contract.
+    if not dtype_sanctioned_file:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Attribute):
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            if _dotted(node) not in F32_LITERAL_DOTTED:
+                continue
+            chain = _enclosing_chain(scopes, node_to_fn, node)
+            if any(info.traced for info in chain):
+                emit(
+                    "dtype-policy", node,
+                    f"bare `{_dotted(node)}` inside a traced function is "
+                    f"an f32 upcast the --dtype policy cannot see (bf16 "
+                    f"silently re-widens, bf16_params forks the param "
+                    f"dtype mid-trace) — spell the contract via "
+                    f"precision.LOSS_DTYPE / WGRAD_DTYPE / REDUCE_DTYPE "
+                    f"or thread the policy",
+                )
+
+    # -- ckpt-dtype-drift: checkpoint restores that bypass the precision
+    # policy's restore seams. The enclosing function of every
+    # load_checkpoint/load_weights call must also call
+    # ensure_restored_dtypes or convert_checkpoint_state (anywhere in its
+    # subtree — the seam usually guards the result a few lines later);
+    # otherwise params of a drifted dtype flow into the jitted step,
+    # which silently RETRACES against donated buffers of the old layout.
+    if not ckpt_rule_exempt_file:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _terminal(node.func) not in CKPT_RESTORE_CALLS:
+                continue
+            chain = _enclosing_chain(scopes, node_to_fn, node)
+            enclosing = chain[0].node if chain else tree
+            has_seam = any(
+                isinstance(sub, ast.Call)
+                and _terminal(sub.func) in CKPT_RESTORE_SEAMS
+                for sub in ast.walk(enclosing)
+            )
+            if not has_seam:
+                emit(
+                    "ckpt-dtype-drift", node,
+                    f"`{_terminal(node.func)}` restores state without "
+                    f"routing it through a precision restore seam "
+                    f"({', '.join(sorted(CKPT_RESTORE_SEAMS))}) — a "
+                    f"checkpoint saved under a different --dtype would "
+                    f"silently retrace the donated-buffer step instead "
+                    f"of re-casting loudly or failing",
+                )
 
     # -- use-after-donation (per function body, EXCLUDING nested defs:
     # a load in a different closure has its own lifetime)

@@ -429,38 +429,15 @@ def resolve_checkpoint(name: str, checkpoint_dir: str = "./checkpoints") -> str:
     raise FileNotFoundError(os.path.join(checkpoint_dir, f"{base}{exts[0]}"))
 
 
-def load_weights(path: str, params_template):
-    """Params from either checkpoint format: native full-state ``.ckpt`` or
-    reference ``.pth`` (NHWC↔NCHW transposes, ``module.`` prefix tolerated).
-    The format rule lives here only — trainer resume and inference share it."""
-    if path.endswith(".pth"):
-        return import_reference_pth(path, params_template)
-    return load_checkpoint(path, params_template, None)["params"]
-
-
-def load_checkpoint(
-    path: str,
-    params_target,
-    opt_state_target=None,
-    model_state_target=None,
-    fallback: bool = True,
-) -> Dict[str, Any]:
-    """Restore a checkpoint into the given target structures.
-
-    Every file is integrity-checked (`_read_verified`); when ``path``
-    itself is corrupt and ``fallback`` is on, restore walks the retention
-    chain (``path.1``, ``path.2``, …) to the newest INTACT file — so a
-    crash mid-write costs one save interval of progress, not the run
-    (`fit_with_restarts` then resumes from the fallback's epoch). All
-    candidates corrupt raises :class:`CheckpointCorruptError`.
-
-    Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch',
-    'records', 'model_state'}``; `opt_state` is None when the checkpoint
-    predates it or no target given, `records` (metric history) and
-    `model_state` (BatchNorm stats) likewise.
-    """
+def read_payload(path: str, fallback: bool = True) -> dict:
+    """The newest INTACT candidate's raw payload dict (retention-chain
+    walk + integrity check — exactly `load_checkpoint`'s file selection,
+    WITHOUT binding any target structures). The restore path reads this
+    once, inspects the manifest to build policy-correct targets, then
+    hands the same payload back to `load_checkpoint` — a multi-GB file
+    must not be read and deserialized twice per resume."""
     candidates = retained_checkpoints(path) if fallback else [path]
-    if not candidates:  # path missing entirely: keep FileNotFoundError
+    if not candidates:
         candidates = [path]
     payload = None
     for cand in candidates:
@@ -480,6 +457,56 @@ def load_checkpoint(
             f"no intact checkpoint among {candidates} — every candidate "
             "failed its integrity check"
         )
+    return payload
+
+
+def peek_topology(path: str, fallback: bool = True) -> Optional[dict]:
+    """The saving-time topology manifest (strategy/mesh/process counts and
+    the ``precision`` policy name) of the checkpoint `load_checkpoint`
+    would restore — WITHOUT building any target structures. None for
+    pre-manifest checkpoints (and raises what `load_checkpoint` would
+    raise when no intact candidate exists)."""
+    return read_payload(path, fallback=fallback).get("topology")
+
+
+def load_weights(path: str, params_template):
+    """Params from either checkpoint format: native full-state ``.ckpt`` or
+    reference ``.pth`` (NHWC↔NCHW transposes, ``module.`` prefix tolerated).
+    The format rule lives here only — trainer resume and inference share it."""
+    if path.endswith(".pth"):
+        return import_reference_pth(path, params_template)
+    return load_checkpoint(path, params_template, None)["params"]
+
+
+def load_checkpoint(
+    path: str,
+    params_target,
+    opt_state_target=None,
+    model_state_target=None,
+    fallback: bool = True,
+    payload: Optional[dict] = None,
+) -> Dict[str, Any]:
+    """Restore a checkpoint into the given target structures.
+
+    Every file is integrity-checked (`_read_verified`); when ``path``
+    itself is corrupt and ``fallback`` is on, restore walks the retention
+    chain (``path.1``, ``path.2``, …) to the newest INTACT file — so a
+    crash mid-write costs one save interval of progress, not the run
+    (`fit_with_restarts` then resumes from the fallback's epoch). All
+    candidates corrupt raises :class:`CheckpointCorruptError`.
+
+    ``payload`` short-circuits the file read: a caller that already ran
+    `read_payload` (the trainer's policy-aware restore peeks the
+    manifest to build its targets) binds against that dict instead of
+    reading and deserializing the file a second time.
+
+    Returns ``{'params', 'opt_state', 'scheduler', 'step', 'epoch',
+    'records', 'model_state'}``; `opt_state` is None when the checkpoint
+    predates it or no target given, `records` (metric history) and
+    `model_state` (BatchNorm stats) likewise.
+    """
+    if payload is None:
+        payload = read_payload(path, fallback=fallback)
     out = {
         "params": flax.serialization.from_state_dict(params_target, payload["params"]),
         "opt_state": None,

@@ -111,6 +111,15 @@ def get_args():
                              "(~half HBM, ~1/3 more FLOPs)")
     parser.add_argument("--pallas", action="store_true",
                         help="Use the fused Pallas loss-stats kernel for eval")
+    parser.add_argument("--dtype", type=str, default="bf16",
+                        choices=["f32", "bf16", "bf16_params"],
+                        help="Mixed-precision policy (ops/precision.py): "
+                             "f32 = pure-float32 reference; bf16 = bf16 "
+                             "conv compute with f32 params/loss (default); "
+                             "bf16_params = bf16 on-device params (halved "
+                             "param bytes) with f32 master weights in "
+                             "optimizer state. Loss, wgrad accumulation, "
+                             "and grad psums stay f32 under every policy")
     parser.add_argument("--s2d-levels", type=int, default=-1,
                         help="Shallow UNet levels executed in the "
                              "space-to-depth domain (exact numerics, ~1.9x "
@@ -282,6 +291,7 @@ def main():
         use_pallas=args.pallas,
         model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
+        dtype=args.dtype,
         s2d_levels=args.s2d_levels,
         wgrad_taps=args.wgrad_taps,
         checkpoint_name=resolve_checkpoint_arg(args),

@@ -86,9 +86,25 @@ class TrainConfig:
     # --schedule sweep / bench_multi pipeline config).
     pipeline_schedule: str = "gpipe"
 
-    # -- precision ----------------------------------------------------------
-    # bfloat16 keeps the MXU fed; params and loss stay float32.
-    compute_dtype: str = "bfloat16"
+    # -- precision (ops/precision.py, docs/PERFORMANCE.md "Precision") ------
+    # The mixed-precision policy, --dtype:
+    #   "f32"         pure-float32 reference (what equivalence bands are
+    #                 measured against);
+    #   "bf16"        bf16 conv/activation compute on the MXU, f32 params
+    #                 and loss — the shipping default, now explicit;
+    #   "bf16_params" bf16 compute AND bf16 on-device params (halved param
+    #                 bytes + FSDP all-gather traffic) with f32 master
+    #                 weights living in optimizer state (Micikevicius et
+    #                 al.'s recipe). Loss/Dice accumulation, wgrad
+    #                 accumulation, and the schedule-closing grad psums
+    #                 stay f32 under EVERY policy (the stated contracts,
+    #                 precision.LOSS_DTYPE/WGRAD_DTYPE/REDUCE_DTYPE).
+    dtype: str = "bf16"
+    # Legacy compute-dtype override (pre-policy tests/benches pass
+    # compute_dtype="float32" for exact comparisons): None = the policy's
+    # own compute dtype; a dtype name overrides conv/activation compute
+    # only — param storage and master weights still follow `dtype`.
+    compute_dtype: Optional[str] = None
 
     # -- model --------------------------------------------------------------
     # "unet" = the reference course model (7,760,097 params); "milesial" =
@@ -250,6 +266,19 @@ class TrainConfig:
     profile_steps: Optional[Tuple[int, int]] = None
 
     @property
+    def precision(self):
+        """Convenience accessor for the resolved
+        :class:`~distributedpytorch_tpu.ops.precision.PrecisionPolicy`.
+        The resolver is ``ops.precision.get_policy(config)`` (honoring
+        the legacy ``compute_dtype`` override) — layers call it directly
+        because it also accepts duck-typed configs; this property wraps
+        the same call for TrainConfig holders, so there is exactly one
+        resolution path."""
+        from distributedpytorch_tpu.ops.precision import get_policy
+
+        return get_policy(self)
+
+    @property
     def val_fraction(self) -> float:
         return self.val_percent / 100.0
 
@@ -278,6 +307,16 @@ class ServeConfig:
     model_widths: Optional[Tuple[int, ...]] = None
     s2d_levels: int = -1
     threshold: float = 0.5
+    # Weights-only quantization for the serving path (--quantize):
+    #   None   — serve the checkpoint's own float weights;
+    #   "int8" — per-output-channel symmetric int8 weights resident on
+    #            device (param bytes quartered vs f32), dequantized
+    #            inside the AOT-compiled forward. Accepts either a
+    #            regular checkpoint (quantized on load) or a file
+    #            written by tools/quantize.py (which also records the
+    #            source hash in its manifest). Dice parity vs the float
+    #            checkpoint is pinned by tests/test_quantize.py.
+    quantize: Optional[str] = None
 
     # -- batching -----------------------------------------------------------
     # The padded bucket ladder: every dispatch rides one of exactly these

@@ -7,15 +7,18 @@ def create_model(config):
 
     ``init_fn(rng, input_hw) -> (params, model_state_or_None)`` — stateful
     models (milesial's BatchNorm) return their non-trainable collections as
-    the second element.
+    the second element. The model's compute dtype comes from the resolved
+    precision policy (config.precision — ops/precision.py), so ``--dtype``
+    and the legacy ``compute_dtype`` override resolve in exactly one place.
     """
-    import jax.numpy as jnp
+    from distributedpytorch_tpu.ops.precision import get_policy
 
+    compute_dtype = get_policy(config).compute_dtype
     arch = getattr(config, "model_arch", "unet")
     if arch == "unet":
         from distributedpytorch_tpu.models.unet import create_unet, init_unet_params
 
-        model = create_unet(config)
+        model = create_unet(config, dtype=compute_dtype)
 
         def init_fn(rng, input_hw):
             return init_unet_params(model, rng, input_hw=input_hw), None
@@ -30,7 +33,7 @@ def create_model(config):
         widths = tuple(config.model_widths) if config.model_widths else MILESIAL_WIDTHS
         model = MilesialUNet(
             widths=widths,
-            dtype=jnp.dtype(config.compute_dtype),
+            dtype=compute_dtype,
             s2d_levels=getattr(config, "s2d_levels", -1),
             wgrad_taps=getattr(config, "wgrad_taps", False),
         )

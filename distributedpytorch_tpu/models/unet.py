@@ -442,10 +442,15 @@ class UNet(nn.Module):
         return self._head(x)
 
     def _head(self, x: jax.Array) -> jax.Array:
+        from distributedpytorch_tpu.ops.precision import LOSS_DTYPE
+
         x = self.segmap(x)
         if self._s2d_levels() > 0:
             x = s2d_ops.depth_to_space(x)  # (B, H/2, W/2, 4·ncls) → (B, H, W, ncls)
-        return jax.nn.sigmoid(x.astype(jnp.float32))
+        # sigmoid in the loss dtype: probabilities feed a log-based loss
+        # and bf16 resolution near 0/1 would poison it (the policy's
+        # LOSS_DTYPE contract — every --dtype keeps this boundary f32)
+        return jax.nn.sigmoid(x.astype(LOSS_DTYPE))
 
     # -- S-stage pipeline segments (parallel/pipeline.py) -------------------
     # The model's linear block order: L encoder levels, the mid block, then
@@ -491,7 +496,13 @@ class UNet(nn.Module):
 def create_unet(config=None, dtype=None) -> UNet:
     """Build a UNet from a TrainConfig (or dtype override)."""
     if dtype is None:
-        dtype = jnp.dtype(config.compute_dtype) if config is not None else jnp.bfloat16
+        from distributedpytorch_tpu.ops.precision import get_policy
+
+        dtype = (
+            get_policy(config).compute_dtype
+            if config is not None
+            else jnp.bfloat16
+        )
     widths = ENCODER_WIDTHS
     if config is not None and getattr(config, "model_widths", None):
         widths = tuple(config.model_widths)

@@ -36,12 +36,21 @@ def adam_l2(learning_rate: float, weight_decay: float = 1e-8) -> optax.GradientT
     return _make(lr=learning_rate)
 
 
+def _hyperparams(opt_state):
+    """The inject_hyperparams dict regardless of precision policy: the
+    bf16_params master-weight wrapper (ops/precision.MasterWeightsState)
+    nests the real state one level down."""
+    from distributedpytorch_tpu.ops.precision import unwrap_opt_state
+
+    return unwrap_opt_state(opt_state).hyperparams
+
+
 def set_learning_rate(opt_state, lr: float):
     """Rewrite the injected lr scalar in-place on the host (no recompile)."""
-    hyperparams = opt_state.hyperparams
+    hyperparams = _hyperparams(opt_state)
     hyperparams["lr"] = jnp.asarray(lr, dtype=jnp.asarray(hyperparams["lr"]).dtype)
     return opt_state
 
 
 def get_learning_rate(opt_state) -> float:
-    return float(opt_state.hyperparams["lr"])
+    return float(_hyperparams(opt_state)["lr"])

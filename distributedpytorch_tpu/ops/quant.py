@@ -1,0 +1,217 @@
+"""Weights-only int8 post-training quantization for the serving tier.
+
+The serving follow-on of the mixed-precision policy (ops/precision.py,
+ROADMAP "int8 weights-only first"): kernels are stored as int8 with
+per-OUTPUT-CHANNEL symmetric scales and dequantized *inside* the traced
+forward, so the device-resident weights really are one byte per element
+— param bytes quartered vs f32 (halved vs bf16), which on a serving host
+is replicated per replica group — while every matmul/conv still computes
+in the model's compute dtype. Weights-only deliberately: activations at
+this model's scale are a small fraction of serve-time memory, and
+skipping activation quantization keeps the scheme calibration-free (no
+representative-batch pass, no clipping heuristics).
+
+Scheme (the standard symmetric per-channel recipe):
+
+    scale[c] = max(|W[..., c]|) / 127          (scale 1 for all-zero c)
+    Q[..., c] = round(W[..., c] / scale[c])    ∈ [-127, 127], int8
+    W'[..., c] = Q[..., c] · scale[c]          (inside the traced forward)
+
+Quantized leaves are kernels only (``ndim >= 2``; flax puts out-features
+on the LAST axis for Conv AND ConvTranspose). Biases, BatchNorm
+scale/bias, and all running statistics stay f32 — they are vectors whose
+bytes are noise and whose precision is not.
+
+File format (``tools/quantize.py`` writes, :func:`load_quantized`
+reads): one msgpack payload through checkpoint.py's integrity-footer
+writer, carrying ``kind`` = :data:`QUANT_KIND`, a ``manifest`` that
+records the SOURCE checkpoint path + sha256 (provenance: which float
+weights produced these ints), the quantization scheme name, and the
+model-identity fields, plus the quantized params and the unquantized
+``model_state``. ``serve --quantize int8`` consumes either this file or
+a regular checkpoint (quantized on load); Dice parity vs the float
+checkpoint is pinned by tests/test_quantize.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+QUANT_KIND = "int8-weights-v1"
+SCHEME = "symmetric-per-out-channel"
+_QLEAF_KEYS = frozenset({"q", "scale"})
+
+
+def _is_quantized_leaf(node) -> bool:
+    return isinstance(node, dict) and set(node.keys()) == _QLEAF_KEYS
+
+
+def quantize_leaf(w: np.ndarray) -> Dict[str, np.ndarray]:
+    """One float kernel → ``{"q": int8, "scale": f32}`` with the scale
+    broadcastable over the last (out-channel) axis."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(range(w.ndim - 1))
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -127, 127).astype(np.int8)
+    return {"q": q, "scale": scale}
+
+
+def quantize_tree(params) -> Any:
+    """Quantize every kernel-shaped float leaf of a params tree; vectors
+    and scalars (biases, BN affine) pass through as f32."""
+    import jax
+
+    def quantize(leaf):
+        arr = np.asarray(jax.device_get(leaf))
+        if arr.ndim >= 2 and np.issubdtype(arr.dtype, np.floating):
+            return quantize_leaf(arr)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr.astype(np.float32)
+        return arr
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return quantize(node)
+
+    import flax.serialization
+
+    return walk(flax.serialization.to_state_dict(params))
+
+
+def dequantize_tree(tree, dtype=None):
+    """The traced-side inverse: ``{"q","scale"}`` subtrees → float
+    kernels (``q · scale``, computed in f32 then cast to ``dtype`` when
+    given). Pure jnp over a static tree structure, so it lowers into the
+    AOT-compiled serve executables — the int8 arrays are the executable's
+    *arguments*, the dequantized floats only ever exist as temps."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if _is_quantized_leaf(node):
+            w = node["q"].astype(jnp.float32) * node["scale"]
+            return w.astype(dtype) if dtype is not None else w
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(tree)
+
+
+def is_quantized_tree(tree) -> bool:
+    """True iff any subtree is a ``{"q","scale"}`` quantized leaf."""
+    if _is_quantized_leaf(tree):
+        return True
+    if isinstance(tree, dict):
+        return any(is_quantized_tree(v) for v in tree.values())
+    return False
+
+
+def quantization_error(params, qtree) -> float:
+    """max |W − W'| over the quantized kernels, as a fraction of each
+    channel's scale (≤ 0.5 by construction — the rounding bound the
+    roundtrip test pins)."""
+    import flax.serialization
+
+    flat: list = []
+
+    def walk(node, qnode):
+        if _is_quantized_leaf(qnode):
+            w = np.asarray(node, np.float32)
+            wq = qnode["q"].astype(np.float32) * qnode["scale"]
+            flat.append(np.max(np.abs(w - wq) / qnode["scale"]))
+        elif isinstance(qnode, dict):
+            for k in qnode:
+                walk(node[k], qnode[k])
+
+    walk(flax.serialization.to_state_dict(params), qtree)
+    return float(max(flat)) if flat else 0.0
+
+
+# ---------------------------------------------------------------------------
+# File format
+# ---------------------------------------------------------------------------
+
+
+def file_sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def save_quantized(
+    path: str,
+    qtree,
+    manifest: Dict[str, Any],
+    model_state=None,
+) -> str:
+    """Write a quantized-weights file (atomic, integrity-footed — the
+    same writer the native checkpoints use). ``manifest`` should carry
+    ``source``/``source_sha256`` (tools/quantize.py fills them)."""
+    import flax.serialization
+
+    from distributedpytorch_tpu.checkpoint import _to_host, _write_payload
+
+    payload = {
+        "kind": QUANT_KIND,
+        "manifest": {"scheme": SCHEME, **manifest},
+        "params": qtree,
+        "model_state": flax.serialization.to_state_dict(_to_host(model_state))
+        if model_state is not None
+        else None,
+    }
+    return _write_payload(path, payload, keep=1)
+
+
+def peek_quantized(path: str) -> Optional[Dict[str, Any]]:
+    """The manifest iff ``path`` is a quantized-weights file, else None
+    (including files that are not valid msgpack — the caller is probing,
+    not asserting)."""
+    if not os.path.isfile(path):
+        return None
+    try:
+        from distributedpytorch_tpu.checkpoint import _read_verified
+
+        payload = _read_verified(path)
+    except Exception:  # noqa: BLE001 — a probe, not a load
+        return None
+    if not isinstance(payload, dict) or payload.get("kind") != QUANT_KIND:
+        return None
+    return dict(payload.get("manifest") or {})
+
+
+def load_quantized(
+    path: str, payload: Optional[Dict[str, Any]] = None
+) -> Tuple[Any, Any, Dict[str, Any]]:
+    """Read a quantized-weights file → ``(qtree, model_state, manifest)``.
+    Integrity-verified by the shared reader; raises ValueError on a file
+    of the wrong kind (a regular checkpoint handed to the int8 loader).
+    ``payload`` short-circuits the file read — a caller that already ran
+    ``checkpoint.read_payload`` (the serve loader probes the kind first)
+    must not deserialize the file twice."""
+    if payload is None:
+        from distributedpytorch_tpu.checkpoint import _read_verified
+
+        payload = _read_verified(path)
+    if payload.get("kind") != QUANT_KIND:
+        raise ValueError(
+            f"{path} is not an int8 weights file (kind="
+            f"{payload.get('kind')!r}); quantize it first with "
+            f"tools/quantize.py or drop --quantize"
+        )
+    manifest = dict(payload.get("manifest") or {})
+    logger.info(
+        "loaded int8 weights %s (source %s, sha256 %.12s…)",
+        path, manifest.get("source"), manifest.get("source_sha256", ""),
+    )
+    return payload["params"], payload.get("model_state"), manifest

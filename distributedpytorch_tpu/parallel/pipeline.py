@@ -99,6 +99,16 @@ from jax.sharding import Mesh, PartitionSpec as P
 from distributedpytorch_tpu.utils.compat import shard_map
 
 from distributedpytorch_tpu.ops.losses import bce_dice_stats, loss_from_stats
+# The stated f32 contracts (ops/precision.py, docs/PERFORMANCE.md
+# "Precision"): loss statistics accumulate in LOSS_DTYPE and per-stage
+# weight gradients in WGRAD_DTYPE under EVERY --dtype policy — bf16
+# params change what autodiff emits per backward tick, never what this
+# schedule accumulates or psums.
+from distributedpytorch_tpu.ops.precision import (
+    LOSS_DTYPE,
+    WGRAD_DTYPE,
+    cast_float_leaves,
+)
 
 PIPELINE_SCHEDULES = ("gpipe", "1f1b")
 
@@ -425,7 +435,7 @@ def make_pipeline_loss_fn(
 
         per_mb_stats, bn_final = _run_schedule(
             stage_fns, M, stage_axis, params, microbatch_input,
-            last_stage_stats, lambda: jnp.zeros((4,), jnp.float32),
+            last_stage_stats, lambda: jnp.zeros((4,), LOSS_DTYPE),
             bn_state=model_state,
         )
         stats_sum = sum(per_mb_stats)
@@ -502,15 +512,26 @@ def make_pipeline_value_and_grad_fn(
             stage_axis=stage_axis, data_axis=data_axis, remat=remat,
             cuts=cuts, use_pallas=use_pallas,
         )
+
+        def _wide(params):
+            # REDUCE_DTYPE contract: differentiate w.r.t. an f32 view of
+            # the params so autodiff's cotangents — and the implicit
+            # schedule-closing psum the shard_map transpose inserts over
+            # ('stage'[,'data']) — reduce in f32 even when the --dtype
+            # policy stores bf16 params (bf16→f32 is exact; the model
+            # re-casts to its compute dtype immediately, so the forward
+            # is unchanged; a no-op for f32 params).
+            return cast_float_leaves(params, WGRAD_DTYPE)
+
         if stateful:
             def gpipe_vag(params, model_state, batch):
                 (loss, new_state), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(params, model_state, batch)
+                )(_wide(params), model_state, batch)
                 return loss, grads, new_state
         else:
             def gpipe_vag(params, model_state, batch):
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                loss, grads = jax.value_and_grad(loss_fn)(_wide(params), batch)
                 return loss, grads, model_state
         return gpipe_vag
 
@@ -558,7 +579,7 @@ def make_pipeline_value_and_grad_fn(
 
         per_mb_stats, bn_final = _run_schedule(
             stage_fns, M, stage_axis, params, microbatch_input,
-            last_stage_stats, lambda: jnp.zeros((4,), jnp.float32),
+            last_stage_stats, lambda: jnp.zeros((4,), LOSS_DTYPE),
             bn_state=model_state if stateful else None,
         )
         stats = jax.lax.psum(sum(per_mb_stats), axes)
@@ -584,7 +605,7 @@ def make_pipeline_value_and_grad_fn(
             jax.eval_shape(lambda p: microbatch_input(0), params)
         )
         grad_zero = jax.tree.map(
-            lambda x: jnp.zeros(x.shape, jnp.float32), params
+            lambda x: jnp.zeros(x.shape, WGRAD_DTYPE), params
         )
         grads = grad_zero
         saved = {}  # (s, m) -> stage input carry, live ≈S−s ticks
@@ -639,7 +660,7 @@ def make_pipeline_value_and_grad_fn(
                         _, vjp = jax.vjp(f, params, payload_in)
                         g_params, g_payload = vjp(ct_in)
                         acc = jax.tree.map(
-                            lambda a, g: a + g.astype(jnp.float32),
+                            lambda a, g: a + g.astype(WGRAD_DTYPE),
                             grads, g_params,
                         )
                         return acc, g_payload
@@ -736,7 +757,7 @@ def make_pipeline_forward_fn(
         out_shape = (mb,) + images.shape[1:3] + (model.n_classes,)
         preds, _ = _run_schedule(
             stage_fns, M, stage_axis, params, microbatch_input,
-            last_stage_preds, lambda: jnp.zeros(out_shape, jnp.float32),
+            last_stage_preds, lambda: jnp.zeros(out_shape, LOSS_DTYPE),
             bn_state=bn,
         )
         out = jnp.concatenate(preds, axis=0)

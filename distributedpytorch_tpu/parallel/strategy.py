@@ -23,6 +23,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributedpytorch_tpu.config import TrainConfig
 from distributedpytorch_tpu.data.loader import ShardSpec
+from distributedpytorch_tpu.ops.precision import get_policy
 from distributedpytorch_tpu.parallel.pipeline import (
     PIPELINE_SCHEDULES,
     make_pipeline_forward_fn,
@@ -81,6 +82,10 @@ class Strategy:
     def __init__(self, config: TrainConfig):
         self.config = config
         self.mesh: Optional[Mesh] = None
+        # the session's precision policy (ops/precision.py, --dtype):
+        # resolved ONCE here; the steps this strategy builds, the
+        # checkpoint manifest, and the restore path all read this object
+        self.policy = get_policy(config)
 
     # -- process topology ---------------------------------------------------
     @property
@@ -104,7 +109,14 @@ class Strategy:
             if self.mesh is None
             else {str(k): int(v) for k, v in self.mesh.shape.items()}
         )
-        return {"strategy": self.name, "mesh": mesh}
+        # "precision" is the ckpt-dtype-drift contract's anchor: restore
+        # compares it against the session policy and converts/re-casts
+        # loudly instead of silently retracing (train/loop._restore)
+        return {
+            "strategy": self.name,
+            "mesh": mesh,
+            "precision": self.policy.name,
+        }
 
     # -- batch semantics ----------------------------------------------------
     @property
@@ -180,6 +192,7 @@ class Strategy:
             faithful_loss_scaling=self.config.faithful_loss_scaling,
             remat=self.config.remat,
             loss_impl=self._train_loss_impl(),
+            policy=self.policy,
         )
 
     def build_train_step(self, model, tx) -> Callable:
@@ -595,6 +608,11 @@ class Pipeline(Strategy):
             loss, grads, model_state = pipeline_vag(
                 state.params, state.model_state, prepped
             )
+            # the wgrad contract at the schedule boundary: 1f1b already
+            # accumulated in WGRAD_DTYPE; gpipe's autodiff emits grads in
+            # the param dtype, so under bf16_params they are stated f32
+            # here, before the faithful-quirk scale can round in bf16
+            grads = self.policy.cast_grads(grads)
             if grad_scale != 1.0:
                 grads = jax.tree.map(lambda g: g * grad_scale, grads)
             updates, opt_state = tx.update(grads, state.opt_state, state.params)

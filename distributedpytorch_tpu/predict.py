@@ -46,6 +46,7 @@ def predict_batches(
     images: Iterable[np.ndarray],
     batch_size: int = 4,
     model_state=None,
+    quantized: bool = False,
 ) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
     """Stream (probs (b,H,W), inputs (b,H,W,3)) pairs over an iterable of
     (H,W,3) float32 arrays. One jit compile for full batches (plus at most
@@ -54,12 +55,14 @@ def predict_batches(
 
     The forward is ``serve/infer.make_forward`` — the function the
     serving tier AOT-compiles per bucket; here it jit-compiles lazily at
-    the offline CLI's two shapes."""
+    the offline CLI's two shapes. ``quantized`` must mirror the bundle's
+    flag when ``params`` is an int8 weights-only tree (ops/quant.py) —
+    the forward then dequantizes in-trace, exactly like serving."""
     import jax
     import jax.numpy as jnp
 
     variables = bundle_variables(model, params, model_state)
-    forward = jax.jit(make_forward(model))
+    forward = jax.jit(make_forward(model, quantized=quantized))
 
     buf: List[np.ndarray] = []
 
@@ -147,7 +150,7 @@ def run_prediction(
     idx = 0
     for probs, inputs in predict_batches(
         bundle.params, bundle.model, load_stream(), batch_size,
-        model_state=bundle.model_state,
+        model_state=bundle.model_state, quantized=bundle.quantized,
     ):
         for prob, inp in zip(probs, inputs):
             stem = out_stem(files[idx])

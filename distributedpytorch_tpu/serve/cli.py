@@ -55,6 +55,14 @@ def get_args(argv=None):
                         help="Model family the checkpoint was trained with")
     parser.add_argument("--model-widths", type=int, nargs="+", default=None)
     parser.add_argument("--s2d-levels", type=int, default=-1)
+    parser.add_argument("--quantize", type=str, default=None,
+                        choices=["int8"],
+                        help="Serve weights-only int8 (per-out-channel "
+                             "symmetric, ops/quant.py): device-resident "
+                             "weight bytes quartered vs f32, dequantized "
+                             "inside the AOT-compiled forward. Accepts a "
+                             "tools/quantize.py file or quantizes a "
+                             "regular checkpoint on load")
     parser.add_argument("--threshold", "-t", type=float, default=0.5)
     parser.add_argument("--buckets", type=int, nargs="+", default=(1, 2, 4, 8),
                         help="Padded batch bucket ladder — one AOT compile "
@@ -100,6 +108,7 @@ def to_config(args):
         model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
         s2d_levels=args.s2d_levels,
+        quantize=args.quantize,
         threshold=args.threshold,
         bucket_sizes=tuple(args.buckets),
         slo_ms=args.slo_ms,

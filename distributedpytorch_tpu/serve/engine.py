@@ -75,6 +75,7 @@ class ServeEngine:
         threshold: float = 0.5,
         host_cache_mb: int = 0,
         channels: int = 3,
+        quantized: bool = False,
     ):
         import jax
 
@@ -86,7 +87,11 @@ class ServeEngine:
             SampleCache(host_cache_mb * 2**20) if host_cache_mb > 0 else None
         )
         self.stateful = bool(getattr(model, "is_stateful", False))
-        self._fwd = make_forward(model)
+        # int8 weights-only serving (ops/quant.py): `params` is the
+        # quantized tree; each replica's device-resident weights stay one
+        # byte per element and the forward dequantizes in-trace
+        self.quantized = bool(quantized)
+        self._fwd = make_forward(model, quantized=self.quantized)
         variables = bundle_variables(model, params, model_state)
 
         devices = jax.devices()
@@ -109,6 +114,7 @@ class ServeEngine:
 
     @classmethod
     def from_bundle(cls, bundle: InferenceBundle, **kwargs) -> "ServeEngine":
+        kwargs.setdefault("quantized", bundle.quantized)
         return cls(
             bundle.model, bundle.params, bundle.model_state,
             input_hw=bundle.input_hw, **kwargs,
@@ -222,14 +228,17 @@ def engine_from_checkpoint(
     model_arch: str = "unet",
     model_widths: Optional[Sequence[int]] = None,
     s2d_levels: int = -1,
+    quantize: Optional[str] = None,
     **engine_kwargs,
 ) -> ServeEngine:
-    """Checkpoint name/path → a ready (AOT-compiled) engine."""
+    """Checkpoint name/path → a ready (AOT-compiled) engine.
+    ``quantize="int8"`` serves weights-only int8 (see
+    serve/infer.load_inference_bundle for the file-vs-on-load rules)."""
     from distributedpytorch_tpu.serve.infer import load_inference_bundle
 
     bundle = load_inference_bundle(
         checkpoint, checkpoint_dir=checkpoint_dir, image_size=image_size,
         model_arch=model_arch, model_widths=model_widths,
-        s2d_levels=s2d_levels,
+        s2d_levels=s2d_levels, quantize=quantize,
     )
     return ServeEngine.from_bundle(bundle, **engine_kwargs)

@@ -411,6 +411,7 @@ class Server:
                 model_arch=cfg.model_arch,
                 model_widths=cfg.model_widths,
                 s2d_levels=cfg.s2d_levels,
+                quantize=getattr(cfg, "quantize", None),
                 bucket_sizes=cfg.bucket_sizes,
                 replicas=cfg.replicas,
                 threshold=cfg.threshold,

@@ -166,8 +166,13 @@ class Trainer:
         # of the deltas reassembles the replicated running stats — no
         # BatchNorm-vs-MP guard anymore.
         lr0 = self.strategy.lr_for(config.learning_rate)
+        # the precision policy (ops/precision.py, --dtype) owns the param
+        # cast-in and, under bf16_params, wraps the optimizer with f32
+        # master weights living in opt_state
+        self.policy = self.strategy.policy
         state, self.tx = create_train_state(
-            params, lr0, config.weight_decay, model_state=model_state
+            params, lr0, config.weight_decay, model_state=model_state,
+            policy=self.policy,
         )
         self.scheduler = ReduceLROnPlateau(
             lr=lr0, patience=config.plateau_patience, factor=config.plateau_factor
@@ -317,8 +322,21 @@ class Trainer:
 
     def _restore(self, name: str, state):
         """Load a checkpoint by name (reference -c flag, train.py:42-43 —
-        with the backslash path bug fixed and full-state resume added)."""
+        with the backslash path bug fixed and full-state resume added).
+
+        Precision-aware (the ckpt-dtype-drift contract, docs/ANALYSIS.md):
+        the manifest's ``precision`` entry is peeked BEFORE any target
+        structure is built, a checkpoint saved under a different --dtype
+        is converted through the policy seams (exact via the f32 master
+        weights in either direction), and every restored params tree is
+        re-cast loudly when its dtype drifted — never silently retraced.
+        """
         from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+        from distributedpytorch_tpu.ops.precision import (
+            POLICIES,
+            convert_checkpoint_state,
+            ensure_restored_dtypes,
+        )
 
         path = resolve_checkpoint(name, self.config.checkpoint_dir)
         self._restored_state = None
@@ -327,14 +345,76 @@ class Trainer:
             # interop: reference-format weights (no optimizer/epoch state)
             from distributedpytorch_tpu.checkpoint import load_weights
 
-            self._restored_state = state.replace(
-                params=load_weights(path, state.params)
+            params = load_weights(path, state.params)
+            if self.policy.master_weights:
+                # weights-only restore under bf16_params: re-seed the
+                # optimizer so its f32 master IS the imported weights —
+                # the fresh-init master would silently win otherwise
+                state = state.replace(opt_state=self.tx.init(params))
+            params = ensure_restored_dtypes(
+                params, self.policy, f"pth restore {path}"
             )
+            self._restored_state = state.replace(params=params)
             logger.info("Loaded reference .pth weights from %s", path)
             return
+        from distributedpytorch_tpu.checkpoint import read_payload
+        from distributedpytorch_tpu.ops.precision import get_policy
+
+        # ONE file read: the manifest decides the target structures, and
+        # the same payload then binds them (a multi-GB checkpoint must
+        # not be deserialized twice per resume)
+        payload = read_payload(path)
+        saved_name = (payload.get("topology") or {}).get("precision")
+        if saved_name is None:
+            # pre-policy checkpoints carried f32 params + a plain Adam
+            # state — structurally the bf16 policy
+            saved_policy = POLICIES["bf16"]
+        else:
+            # unknown names fail LOUDLY (a newer build's policy, a
+            # corrupted manifest) — guessing a structure here would die
+            # later in an opaque from_state_dict mismatch
+            saved_policy = get_policy(saved_name)
+        opt_target = state.opt_state
+        if saved_policy.master_weights != self.policy.master_weights:
+            # the saved opt_state's STRUCTURE differs (the master-weight
+            # wrapper nests it) — build the saved-side target to restore
+            # into, then convert below
+            from distributedpytorch_tpu.ops.optim import adam_l2
+
+            saved_tx = saved_policy.wrap_optimizer(
+                adam_l2(self.scheduler.lr, self.config.weight_decay)
+            )
+            # abstract target: from_state_dict needs only the STRUCTURE,
+            # so eval_shape builds it without a host copy of the params
+            # or throwaway f32 master/m/v allocations (~3x param bytes
+            # on the restore path of a large model)
+            opt_target = jax.eval_shape(saved_tx.init, state.params)
         restored = load_checkpoint(
-            path, state.params, state.opt_state, state.model_state
+            path, state.params, opt_target, state.model_state,
+            payload=payload,
         )
+        # params in the SAVED dtype, before the policy conversion casts —
+        # the exact master seed for weights-only checkpoints below
+        raw_params = restored["params"]
+        restored["params"], restored["opt_state"] = convert_checkpoint_state(
+            saved_policy,
+            self.policy,
+            restored["params"],
+            restored["opt_state"],
+            where=f"restore {path}",
+        )
+        if restored["opt_state"] is None and self.policy.master_weights:
+            # weights-only native checkpoint (no opt_state saved) under a
+            # master-weight policy: re-seed the optimizer from the SAVED
+            # params so the f32 master IS the restored weights — same
+            # hazard the .pth branch guards: the fresh-init master would
+            # otherwise revert the params at the first update
+            logger.warning(
+                "restore %s: checkpoint carries no optimizer state — "
+                "re-seeding the %r master weights from the restored "
+                "params", path, self.policy.name,
+            )
+            restored["opt_state"] = self.tx.init(raw_params)
         # Mesh-resharding restore (docs/RELIABILITY.md "Elastic runs"):
         # checkpoints hold FULL host arrays (every sharded leaf was
         # allgathered at save time), so restoring under a DIFFERENT
@@ -348,6 +428,9 @@ class Trainer:
             from distributedpytorch_tpu.checkpoint import save_topology
 
             current_topo = {**save_topology(), **self.strategy.topology()}
+            # a --dtype change is a precision conversion, not a mesh
+            # reshard — convert_checkpoint_state logged it above
+            current_topo.pop("precision", None)
             if {k: saved_topo.get(k) for k in current_topo} != current_topo:
                 logger.warning(
                     "mesh-resharding restore: checkpoint saved under %s, "

@@ -14,22 +14,29 @@ utils/train_utils.py:59-70):
   * Adam update with the lr read from optimizer state (ops/optim.py), so the
     host-side plateau scheduler never recompiles the step.
 
-TPU notes: the model computes in bfloat16 (MXU) with float32 params and a
-float32 loss; the grad is taken w.r.t. float32 params directly — XLA inserts
-the casts once at trace time. Inputs are NHWC.
+TPU notes: precision is governed by the session's PrecisionPolicy
+(ops/precision.py, ``--dtype``): under ``f32``/``bf16`` the grad is taken
+w.r.t. float32 params directly (XLA inserts the compute-dtype casts once at
+trace time); under ``bf16_params`` the on-device params are bf16 and the
+policy's master-weight optimizer wrapper runs Adam against an f32 master in
+optimizer state, with grads stated f32 at the optimizer boundary
+(``policy.cast_grads`` — the wgrad contract). The loss is f32 under every
+policy (ops/losses.py pins it). Inputs are NHWC.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import flax.struct
 import jax
 import jax.numpy as jnp
 import optax
 
+from distributedpytorch_tpu.ops import precision as precision_ops
 from distributedpytorch_tpu.ops.losses import bce_dice_loss, dice_coefficient
 from distributedpytorch_tpu.ops.optim import adam_l2
+from distributedpytorch_tpu.ops.precision import PrecisionPolicy
 
 
 @flax.struct.dataclass
@@ -52,12 +59,29 @@ def create_train_state(
     learning_rate: float,
     weight_decay: float = 1e-8,
     model_state=None,
+    policy: Optional[PrecisionPolicy] = None,
 ) -> Tuple[TrainState, optax.GradientTransformation]:
+    """Build the TrainState + optimizer under a precision policy.
+
+    ``policy=None`` keeps the historical behavior (params as given, plain
+    Adam) — exactly the ``f32``/``bf16`` policies. Under ``bf16_params``
+    the params are cast-in to their bf16 on-device storage dtype and the
+    optimizer is wrapped with f32 master weights (the master is seeded
+    from the params BEFORE the down-cast, so fresh-init and restored f32
+    weights lose nothing to the storage dtype)."""
     tx = adam_l2(learning_rate, weight_decay)
+    if policy is not None:
+        tx = policy.wrap_optimizer(tx)
+        # init the (wrapped) optimizer on the FULL-precision params: the
+        # master-weight wrapper promotes its copy from what it is given
+        opt_state = tx.init(params)
+        params = policy.cast_params(params)
+    else:
+        opt_state = tx.init(params)
     return (
         TrainState(
             params=params,
-            opt_state=tx.init(params),
+            opt_state=opt_state,
             step=jnp.zeros((), jnp.int32),
             model_state=model_state,
         ),
@@ -137,6 +161,7 @@ def make_train_step(
     faithful_loss_scaling: bool = True,
     remat: bool = False,
     loss_impl: Callable = None,
+    policy: Optional[PrecisionPolicy] = None,
 ) -> Callable[[TrainState, Dict[str, jax.Array]], Tuple[TrainState, jax.Array]]:
     """Build the (unjitted) train step; the strategy decides how to jit/shard
     it. Returns ``step(state, batch) -> (state, unscaled_loss)``.
@@ -149,6 +174,12 @@ def make_train_step(
     `loss_impl` swaps the loss computation (default: the XLA
     `bce_dice_loss`); strategies pass the fused Pallas loss under
     ``--pallas`` (Strategy._train_loss_impl).
+
+    `policy` is the session's precision policy: under a master-weight
+    policy the backward's grads come out in the bf16 param dtype and are
+    stated f32 HERE — before the faithful-quirk scaling, so the scale
+    multiply never rounds in bf16 (the wgrad contract's step-entry end;
+    the optimizer-boundary end lives in the master-weight wrapper).
     """
 
     grad_scale = float(batch_size) if faithful_loss_scaling else 1.0
@@ -168,6 +199,8 @@ def make_train_step(
         (loss, model_state), grads = jax.value_and_grad(value_fn, has_aux=True)(
             state.params
         )
+        if policy is not None:
+            grads = policy.cast_grads(grads)
         if grad_scale != 1.0:
             # (batch_size * loss).backward() parity, reference train_utils.py:69
             grads = jax.tree.map(lambda g: g * grad_scale, grads)
@@ -219,6 +252,12 @@ def make_accum_train_step(
     (place with `strategy.place_stacked_batch`). Stateful models
     (BatchNorm) are rejected — per-chunk statistics have no single
     faithful semantics; use a data-parallel mesh for large batches there.
+
+    Precision: the stats accumulator is LOSS_DTYPE and the pass-2 grad
+    accumulator is WGRAD_DTYPE (ops/precision.py) under EVERY policy —
+    under ``bf16_params`` each chunk's VJP emits bf16 leaves and summing
+    K of them in bf16 would violate the stated f32 wgrad-accumulation
+    contract the pipeline schedules already honor.
     """
     if _is_stateful(model):
         raise ValueError(
@@ -259,15 +298,25 @@ def make_accum_train_step(
         def pass1(carry, chunk):
             return carry + fwd(params, chunk), None
 
-        stats, _ = jax.lax.scan(pass1, jnp.zeros((4,), jnp.float32), stacked)
+        stats, _ = jax.lax.scan(
+            pass1, jnp.zeros((4,), precision_ops.LOSS_DTYPE), stacked
+        )
         loss, ct = jax.value_and_grad(loss_from_stats)(stats)
 
         def pass2(carry, chunk):
             _, vjp = jax.vjp(lambda p: fwd(p, chunk), params)
             (g,) = vjp(ct)
-            return jax.tree.map(jnp.add, carry, g), None
+            return (
+                jax.tree.map(
+                    lambda a, x: a + x.astype(precision_ops.WGRAD_DTYPE),
+                    carry, g,
+                ),
+                None,
+            )
 
-        zeros = jax.tree.map(jnp.zeros_like, params)
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, precision_ops.WGRAD_DTYPE), params
+        )
         grads, _ = jax.lax.scan(pass2, zeros, stacked)
         if grad_scale != 1.0:
             grads = jax.tree.map(lambda g: g * grad_scale, grads)

@@ -674,3 +674,129 @@ class TestCli:
         payload = json.loads(report.read_text())
         assert payload["clean"] is False
         assert payload["findings"][0]["rule"] == "rank-gated-collective"
+
+
+class TestDtypePolicyRule:
+    """The mixed-precision cast-boundary rule (ops/precision.py,
+    docs/PERFORMANCE.md "Precision"): bare f32 spellings in traced code
+    are upcasts the --dtype policy cannot see. The ROADMAP's
+    "dtype-policy rule once bf16 lands" item."""
+
+    def test_bare_f32_literal_in_make_builder_flagged(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def make_train_step(model):\n"
+            "    def step(state, batch):\n"
+            "        g = batch['x'].astype(jnp.float32)\n"
+            "        z = jnp.zeros((4,), jnp.float32)\n"
+            "        return g, z\n"
+            "    return step\n"
+        )
+        findings = lint.lint_source(src, "train/steps.py")
+        assert [f.rule for f in findings] == ["dtype-policy", "dtype-policy"]
+
+    def test_string_f32_spellings_flagged(self):
+        src = (
+            "def make_step(model):\n"
+            "    def step(x):\n"
+            "        a = x.astype('float32')\n"
+            "        import jax.numpy as jnp\n"
+            "        b = jnp.zeros((2,), dtype='float32')\n"
+            "        return a, b\n"
+            "    return step\n"
+        )
+        findings = lint.lint_source(src, "train/steps.py")
+        assert [f.rule for f in findings] == ["dtype-policy", "dtype-policy"]
+
+    def test_named_contract_constant_is_the_sanctioned_spelling(self):
+        src = (
+            "from distributedpytorch_tpu.ops.precision import WGRAD_DTYPE\n"
+            "import jax.numpy as jnp\n"
+            "def make_step(model):\n"
+            "    def step(x):\n"
+            "        return jnp.zeros((4,), WGRAD_DTYPE)\n"
+            "    return step\n"
+        )
+        assert lint.lint_source(src, "train/steps.py") == []
+
+    def test_host_code_not_flagged(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def host_prep(x):\n"
+            "    return x.astype(jnp.float32)\n"
+        )
+        assert lint.lint_source(src, "train/loop.py") == []
+
+    def test_sanctioned_loss_and_kernel_modules_exempt(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def make_stats(model):\n"
+            "    def stats(x):\n"
+            "        return x.astype(jnp.float32).sum()\n"
+            "    return stats\n"
+        )
+        for mod in ("ops/losses.py", "ops/precision.py",
+                    "ops/pallas_kernels.py"):
+            assert lint.lint_source(src, mod) == [], mod
+
+    def test_inline_suppression(self):
+        src = (
+            "import jax.numpy as jnp\n"
+            "def make_step(model):\n"
+            "    def step(x):\n"
+            "        return x.astype(jnp.float32)  "
+            "# dptlint: disable=dtype-policy — measured exact seam\n"
+            "    return step\n"
+        )
+        assert lint.lint_source(src, "train/steps.py") == []
+
+
+class TestCkptDtypeDriftRule:
+    """Restores must route through the precision restore seams
+    (ensure_restored_dtypes / convert_checkpoint_state) — a drifted-dtype
+    restore otherwise silently retraces the donated-buffer step."""
+
+    def test_naked_restore_flagged(self):
+        src = (
+            "def restore(path, template):\n"
+            "    out = load_checkpoint(path, template)\n"
+            "    return out['params']\n"
+        )
+        findings = lint.lint_source(src, "train/loop.py")
+        assert [f.rule for f in findings] == ["ckpt-dtype-drift"]
+
+    def test_naked_load_weights_flagged(self):
+        src = (
+            "def restore(path, template):\n"
+            "    return load_weights(path, template)\n"
+        )
+        findings = lint.lint_source(src, "serve/infer.py")
+        assert [f.rule for f in findings] == ["ckpt-dtype-drift"]
+
+    def test_seam_in_enclosing_function_sanctions(self):
+        for seam in ("ensure_restored_dtypes", "convert_checkpoint_state"):
+            src = (
+                "def restore(path, template, policy):\n"
+                "    out = load_checkpoint(path, template)\n"
+                f"    return {seam}(out, policy, 'restore')\n"
+            )
+            assert lint.lint_source(src, "train/loop.py") == [], seam
+
+    def test_checkpoint_module_itself_exempt(self):
+        src = (
+            "def load_weights(path, template):\n"
+            "    return load_checkpoint(path, template, None)['params']\n"
+        )
+        assert lint.lint_source(src, "checkpoint.py") == []
+
+    def test_shipped_restore_paths_are_clean(self):
+        # the trainer's _restore and the serve loader both carry the seam
+        import distributedpytorch_tpu.serve.infer as infer_mod
+        import distributedpytorch_tpu.train.loop as loop_mod
+
+        for mod in (loop_mod, infer_mod):
+            findings = [
+                f for f in lint.lint_file(mod.__file__)
+                if f.rule == "ckpt-dtype-drift"
+            ]
+            assert findings == [], (mod.__name__, findings)

@@ -572,3 +572,75 @@ class TestSupervisorRestarts:
         counts onto unrelated sessions."""
         monkeypatch.delenv("DPT_ELASTIC_REPORT", raising=False)
         assert bench_multi.supervisor_restarts() is None
+
+
+class TestDtypeSweepConfig:
+    """The precision-policy A/B as a bench_multi config (ISSUE 8):
+    registered with a budget, dispatched to tools/bench_dtype.py
+    in-process, and — single-device, collective-free — skipped by the
+    static preflight like serve_bench, never blocked on a vacuous
+    check."""
+
+    def test_registered_with_budget(self):
+        rows = [(n, e, b) for n, e, b in bench_multi.CONFIGS
+                if e.get("BENCH_DTYPE_SWEEP") == "1"]
+        assert len(rows) == 1
+        name, _env, budget = rows[0]
+        assert name == "dtype_sweep"
+        assert budget >= 300.0  # 3 train-step + 2 forward compiles + steps
+
+    def test_preflight_treats_dtype_sweep_as_non_collective(self):
+        assert bench_multi._preflight_combos({"BENCH_DTYPE_SWEEP": "1"}) == ()
+
+    def test_dispatched_in_process_with_budget(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        configs = [("dtype_sweep", {"BENCH_DTYPE_SWEEP": "1"}, 900.0)]
+        mod = TestMainLoop._fake_bench(None, [])
+        TestMainLoop._patch(None, monkeypatch, tmp_path, True, mod, configs)
+
+        def never(*a):
+            raise AssertionError("preflight ran for the collective-free "
+                                 "dtype sweep")
+
+        monkeypatch.setattr(bench_multi, "_run_analyze", never)
+        import tools.bench_dtype as bench_dtype
+
+        calls = []
+
+        def fake_sweep(budget_s=0.0, **kwargs):
+            calls.append(budget_s)
+            return {"kind": "dtype_sweep", "rows": []}
+
+        monkeypatch.setattr(bench_dtype, "dtype_sweep", fake_sweep)
+        assert bench_multi.main(["--out", out]) == 0
+        assert calls == [900.0]
+        assert bench_multi.load_state(out) == {"dtype_sweep": "ok"}
+
+
+class TestDtypeSweepTool:
+    """tools/bench_dtype.py itself on the CPU tier at tiny size: every
+    policy cell runs, the memory claims hold (param bytes halved under
+    bf16_params, int8 serve weights < 0.3x f32), budget exhaustion skips
+    cleanly instead of overrunning."""
+
+    def test_tiny_sweep_end_to_end(self):
+        from tools.bench_dtype import dtype_sweep
+
+        s = dtype_sweep(batch=4, hw=(16, 24), widths=(8,), steps=1)
+        by = {r["policy"]: r for r in s["rows"]}
+        assert set(by) == {"f32", "bf16", "bf16_params",
+                           "serve_f32", "serve_int8"}
+        for name in ("f32", "bf16", "bf16_params"):
+            assert by[name].get("step_ms") is not None, by[name]
+        assert s["bf16_params_param_bytes_ratio"] == 0.5
+        assert s["int8_weight_bytes_ratio"] < 0.3
+
+    def test_budget_exhausted_skips_cells(self):
+        from tools.bench_dtype import dtype_sweep
+
+        s = dtype_sweep(batch=4, hw=(16, 24), widths=(8,), steps=1,
+                        budget_s=1e-9)
+        skipped = [r for r in s["rows"] if r.get("skipped") == "budget"]
+        # every cell — 3 policies + the 2 serve-forward labels — leaves
+        # an explicit marker; none overran, none vanished silently
+        assert len(skipped) == 5

@@ -79,3 +79,27 @@ def test_elastic_worker_flags():
     assert args.heartbeat_interval == 0.25
     assert args.checkpoint_dir == "/ckpts"
     assert args.inject_fault == ["rank_kill@1:1:6"]
+
+
+def test_dtype_policy_flag():
+    """--dtype (ops/precision.py): bf16 stays the shipping default, the
+    three policies parse, and an unknown policy is an argparse error."""
+    import pytest
+
+    assert _parse([]).dtype == "bf16"
+    for name in ("f32", "bf16", "bf16_params"):
+        assert _parse(["--dtype", name]).dtype == name
+    with pytest.raises(SystemExit):
+        _parse(["--dtype", "fp8"])
+
+
+def test_serve_quantize_flag():
+    """serve --quantize: off by default, int8 parses, junk rejected."""
+    import pytest
+
+    from distributedpytorch_tpu.serve.cli import get_args as serve_args
+
+    assert serve_args(["-c", "x"]).quantize is None
+    assert serve_args(["-c", "x", "--quantize", "int8"]).quantize == "int8"
+    with pytest.raises(SystemExit):
+        serve_args(["-c", "x", "--quantize", "int4"])

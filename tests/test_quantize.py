@@ -1,0 +1,385 @@
+"""int8 weights-only quantization (ops/quant.py, tools/quantize.py,
+``serve --quantize int8``).
+
+The serving follow-on of the precision policy: per-output-channel
+symmetric int8 kernels dequantized INSIDE the AOT-compiled forward, so
+device-resident weight bytes are quartered vs f32 while compute numerics
+stay float. Pinned here:
+
+* the scheme itself — per-channel scales, rounding error ≤ 0.5 scale
+  units, all-zero channels safe;
+* the file format — integrity-footed, manifest carries the SOURCE
+  checkpoint sha256 (provenance), regular checkpoints are rejected by
+  the int8 loader and probed as non-quantized by the peeker;
+* the serve A/B the ISSUE names: int8 Dice within 0.5 pt of the f32
+  engine on fixture images, masks BIT-IDENTICAL across bucket shapes
+  (pad rows can't perturb per-sample forwards), and weight bytes
+  actually quartered on the replica;
+* tools/quantize.py end to end, and the quantize-on-load convenience
+  path producing the same masks as the persisted file.
+
+One tiny model is trained ONCE at module scope (2 epochs on the
+synthetic fixture set — enough structure for Dice to be meaningful).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from distributedpytorch_tpu.config import TrainConfig
+from distributedpytorch_tpu.ops import quant
+
+H, W = 32, 48
+WIDTHS = (8, 16)
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """(checkpoint_path, fixture images (N,H,W,3), fixture masks (N,H,W))."""
+    from distributedpytorch_tpu.data import SyntheticSegmentationDataset
+    from distributedpytorch_tpu.train import Trainer
+
+    root = tmp_path_factory.mktemp("q8")
+    cfg = TrainConfig(
+        train_method="singleGPU", dtype="f32", epochs=2, batch_size=4,
+        learning_rate=3e-4, val_percent=25.0, seed=42, image_size=(W, H),
+        model_widths=WIDTHS, synthetic_samples=24,
+        checkpoint_dir=str(root / "ck"), log_dir=str(root / "lg"),
+        loss_dir=str(root / "ls"), num_workers=0,
+    )
+    Trainer(cfg).train()
+    ds = SyntheticSegmentationDataset(length=8, newsize=(W, H), seed=7)
+    items = [ds[i] for i in range(len(ds))]
+    images = np.stack([it["image"] for it in items]).astype(np.float32)
+    masks = np.stack([it["mask"] for it in items])
+    return str(root / "ck" / "singleGPU.ckpt"), images, masks
+
+
+@pytest.fixture(scope="module")
+def engines(trained, tmp_path_factory):
+    """(f32 engine, int8 engine from a tools/quantize.py file)."""
+    import sys
+
+    sys.path.insert(0, ".")
+    from tools.quantize import main as quantize_main
+
+    from distributedpytorch_tpu.serve.engine import engine_from_checkpoint
+
+    ckpt, _imgs, _masks = trained
+    out = str(tmp_path_factory.mktemp("q8f") / "singleGPU.int8.ckpt")
+    rc = quantize_main([
+        "-c", ckpt, "--image-size", str(W), str(H),
+        "--model-widths", *[str(w) for w in WIDTHS], "-o", out,
+    ])
+    assert rc == 0
+    common = dict(image_size=(W, H), model_widths=WIDTHS,
+                  bucket_sizes=(1, 2, 4, 8))
+    return (
+        engine_from_checkpoint(ckpt, **common),
+        engine_from_checkpoint(out, quantize="int8", **common),
+    )
+
+
+class TestScheme:
+    def test_roundtrip_error_bounded_by_half_scale(self):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(3, 3, 8, 16)).astype(np.float32)
+        q = quant.quantize_leaf(w)
+        assert q["q"].dtype == np.int8
+        deq = q["q"].astype(np.float32) * q["scale"]
+        assert np.max(np.abs(w - deq) / q["scale"]) <= 0.5 + 1e-6
+
+    def test_scales_are_per_output_channel(self):
+        w = np.zeros((3, 3, 4, 2), np.float32)
+        w[..., 0] = 1000.0
+        w[..., 1] = 0.001
+        q = quant.quantize_leaf(w)
+        scale = q["scale"].reshape(-1)
+        assert scale[0] == pytest.approx(1000.0 / 127)
+        assert scale[1] == pytest.approx(0.001 / 127)
+        # each channel uses the full int8 range despite the 1e6 spread
+        assert np.max(np.abs(q["q"][..., 0])) == 127
+        assert np.max(np.abs(q["q"][..., 1])) == 127
+
+    def test_all_zero_channel_is_safe(self):
+        w = np.zeros((3, 3, 2, 2), np.float32)
+        w[..., 1] = 5.0
+        q = quant.quantize_leaf(w)
+        assert np.all(np.isfinite(q["scale"]))
+        assert np.all(q["q"][..., 0] == 0)
+
+    def test_tree_quantizes_kernels_only(self):
+        tree = {
+            "conv": {"kernel": np.ones((3, 3, 4, 8), np.float32),
+                     "bias": np.ones((8,), np.float32)},
+        }
+        qtree = quant.quantize_tree(tree)
+        assert set(qtree["conv"]["kernel"].keys()) == {"q", "scale"}
+        assert qtree["conv"]["bias"].dtype == np.float32  # weights-only
+        assert quant.is_quantized_tree(qtree)
+        assert not quant.is_quantized_tree(tree)
+
+    def test_dequantize_tree_inverts_structure(self):
+        rng = np.random.default_rng(1)
+        tree = {"k": rng.normal(size=(2, 2, 3, 4)).astype(np.float32)}
+        deq = quant.dequantize_tree(quant.quantize_tree(tree))
+        assert np.asarray(deq["k"]).shape == (2, 2, 3, 4)
+        err = quant.quantization_error(tree, quant.quantize_tree(tree))
+        assert err <= 0.5 + 1e-6
+
+
+class TestFileFormat:
+    def test_save_load_roundtrip_with_manifest(self, tmp_path, trained):
+        ckpt, _i, _m = trained
+        tree = {"k": np.ones((2, 2, 3, 4), np.float32)}
+        qtree = quant.quantize_tree(tree)
+        path = str(tmp_path / "w.int8.ckpt")
+        quant.save_quantized(
+            path, qtree,
+            {"source": ckpt, "source_sha256": quant.file_sha256(ckpt)},
+        )
+        loaded, model_state, manifest = quant.load_quantized(path)
+        assert model_state is None
+        assert manifest["scheme"] == quant.SCHEME
+        assert manifest["source_sha256"] == quant.file_sha256(ckpt)
+        assert np.array_equal(loaded["k"]["q"], qtree["k"]["q"])
+        assert np.array_equal(loaded["k"]["scale"], qtree["k"]["scale"])
+        assert loaded["k"]["q"].dtype == np.int8
+
+    def test_regular_checkpoint_probes_non_quantized(self, trained):
+        ckpt, _i, _m = trained
+        assert quant.peek_quantized(ckpt) is None
+        with pytest.raises(ValueError, match="not an int8 weights file"):
+            quant.load_quantized(ckpt)
+
+    def test_peek_on_missing_or_garbage_is_none(self, tmp_path):
+        assert quant.peek_quantized(str(tmp_path / "nope")) is None
+        garbage = tmp_path / "g.bin"
+        garbage.write_bytes(b"not msgpack at all")
+        assert quant.peek_quantized(str(garbage)) is None
+
+
+class TestServeInt8:
+    def test_dice_within_half_point_of_f32(self, engines, trained):
+        """The ISSUE's A/B: |Dice(f32) − Dice(int8)| ≤ 0.5 pt on fixture
+        images at the serving threshold — plus a discriminating parity
+        check at an operating point where positives actually exist (the
+        CPU-budget fixture model's probabilities sit below 0.5, so the
+        standard-threshold Dice alone would pass vacuously): at the f32
+        probabilities' own 80th percentile, the two engines' masks must
+        agree to Dice ≥ 0.99, and raw probabilities within 1e-2. (The
+        fixture model's probs cluster tightly at that quantile, so
+        near-threshold flips dominate the measured 0.993 agreement — a
+        trained model's separated distribution agrees far closer.)"""
+        from distributedpytorch_tpu.ops.losses import dice_coefficient
+
+        _ckpt, images, masks = trained
+        eng_f, eng_q = engines
+        import jax.numpy as jnp
+
+        target = jnp.asarray(masks)[..., None].astype(jnp.float32)
+
+        def probs_of(eng):
+            return np.concatenate(
+                [eng.infer(images[i : i + 4]) for i in range(0, len(images), 4)]
+            )
+
+        probs_f, probs_q = probs_of(eng_f), probs_of(eng_q)
+
+        def dice(probs):
+            return float(
+                dice_coefficient(jnp.asarray(probs)[..., None], target)
+            )
+
+        assert abs(dice(probs_f) - dice(probs_q)) <= 0.005
+        assert float(np.max(np.abs(probs_f - probs_q))) < 1e-2
+        thr = float(np.quantile(probs_f, 0.8))
+        mf, mq = probs_f >= thr, probs_q >= thr
+        inter = float(np.sum(mf & mq))
+        agreement = 2.0 * inter / max(1.0, float(mf.sum() + mq.sum()))
+        assert mf.sum() > 0  # the operating point has real positives
+        assert agreement >= 0.99, agreement
+
+    def test_masks_bit_identical_across_bucket_shapes(self, engines, trained):
+        _ckpt, images, _masks = trained
+        _eng_f, eng_q = engines
+        # the same row served alone (bucket 1) and inside padded buckets
+        # (2, 4, 8) must produce byte-identical masks
+        row = images[:1]
+        ref = eng_q.postprocess(eng_q.infer(row))[0]
+        for n in (2, 3, 5):
+            batch = images[:n]
+            masks_n = eng_q.postprocess(eng_q.infer(batch))
+            assert np.array_equal(masks_n[0], ref), n
+
+    def test_replica_weight_bytes_quartered(self, engines):
+        from distributedpytorch_tpu.ops.precision import param_bytes
+
+        eng_f, eng_q = engines
+        ratio = param_bytes(eng_q.replicas[0].variables) / param_bytes(
+            eng_f.replicas[0].variables
+        )
+        # int8 kernels + f32 scales/biases: strictly under bf16's 0.5,
+        # approaching 0.25 as widths grow (measured 0.26 at these widths)
+        assert ratio < 0.3, ratio
+
+    def test_quantize_on_load_matches_persisted_file(self, trained, engines):
+        from distributedpytorch_tpu.serve.engine import engine_from_checkpoint
+
+        ckpt, images, _masks = trained
+        _eng_f, eng_q = engines
+        eng_onload = engine_from_checkpoint(
+            ckpt, quantize="int8", image_size=(W, H), model_widths=WIDTHS,
+            bucket_sizes=(1, 2, 4, 8),
+        )
+        a = eng_onload.postprocess(eng_onload.infer(images[:4]))
+        b = eng_q.postprocess(eng_q.infer(images[:4]))
+        assert np.array_equal(a, b)
+
+    def test_int8_file_autodetected_without_flag(self, engines, trained,
+                                                 tmp_path_factory):
+        import sys
+
+        sys.path.insert(0, ".")
+        from tools.quantize import main as quantize_main
+
+        from distributedpytorch_tpu.serve.infer import load_inference_bundle
+
+        ckpt, _i, _m = trained
+        out = str(tmp_path_factory.mktemp("qauto") / "w.int8.ckpt")
+        assert quantize_main([
+            "-c", ckpt, "--image-size", str(W), str(H),
+            "--model-widths", *[str(w) for w in WIDTHS], "-o", out,
+        ]) == 0
+        bundle = load_inference_bundle(
+            out, image_size=(W, H), model_widths=WIDTHS
+        )
+        assert bundle.quantized
+
+    def test_predict_cli_serves_int8_file(self, engines, trained, tmp_path):
+        """The offline predict surface on an int8 weights file: the
+        bundle auto-detects, predict_batches threads the quantized flag,
+        and the written masks equal the int8 engine's (review
+        regression: predict used to hand qtrees to the float forward)."""
+        from PIL import Image
+
+        from distributedpytorch_tpu.predict import run_prediction
+
+        import sys
+
+        sys.path.insert(0, ".")
+        from tools.quantize import main as quantize_main
+
+        ckpt, images, _masks = trained
+        _eng_f, eng_q = engines
+        out8 = str(tmp_path / "w.int8.ckpt")
+        assert quantize_main([
+            "-c", ckpt, "--image-size", str(W), str(H),
+            "--model-widths", *[str(w) for w in WIDTHS], "-o", out8,
+        ]) == 0
+        in_dir = tmp_path / "imgs"
+        in_dir.mkdir()
+        for i in range(2):
+            Image.fromarray(
+                (images[i] * 255).astype(np.uint8)
+            ).save(in_dir / f"car{i}.png")
+        written = run_prediction(
+            out8, str(in_dir), str(tmp_path / "masks"),
+            image_size=(W, H), model_widths=WIDTHS, batch_size=2,
+        )
+        assert len(written) == 2
+        # parity with the served int8 engine on the same decoded inputs
+        rows = np.stack([
+            eng_q.preprocess(str(in_dir / f"car{i}.png")) for i in range(2)
+        ])
+        expect = eng_q.postprocess(eng_q.infer(rows))
+        for i, path in enumerate(sorted(written)):
+            got = np.asarray(Image.open(path))
+            assert np.array_equal(got, expect[i]), path
+
+    def test_mismatched_model_identity_fails_loudly(self, engines, trained,
+                                                    tmp_path):
+        """A quantized file's manifest pins the model identity it was
+        produced for — wrong --model-widths must be a named ValueError,
+        not an opaque flax shape error deep in the AOT compile."""
+        import sys
+
+        sys.path.insert(0, ".")
+        from tools.quantize import main as quantize_main
+
+        from distributedpytorch_tpu.serve.infer import load_inference_bundle
+
+        ckpt, _i, _m = trained
+        out = str(tmp_path / "w.int8.ckpt")
+        assert quantize_main([
+            "-c", ckpt, "--image-size", str(W), str(H),
+            "--model-widths", *[str(w) for w in WIDTHS], "-o", out,
+        ]) == 0
+        with pytest.raises(ValueError, match="model_widths"):
+            load_inference_bundle(out, image_size=(W, H), model_widths=(4,))
+        with pytest.raises(ValueError, match="--model"):
+            load_inference_bundle(
+                out, image_size=(W, H), model_widths=WIDTHS,
+                model_arch="milesial",
+            )
+
+    def test_already_quantized_source_rejected_by_tool(self, engines, trained,
+                                                       tmp_path_factory):
+        import sys
+
+        sys.path.insert(0, ".")
+        from tools.quantize import main as quantize_main
+
+        ckpt, _i, _m = trained
+        out = str(tmp_path_factory.mktemp("qq") / "w.int8.ckpt")
+        assert quantize_main([
+            "-c", ckpt, "--image-size", str(W), str(H),
+            "--model-widths", *[str(w) for w in WIDTHS], "-o", out,
+        ]) == 0
+        assert quantize_main([
+            "-c", out, "--image-size", str(W), str(H),
+            "--model-widths", *[str(w) for w in WIDTHS],
+        ]) == 2
+
+
+class TestArgumentBytes:
+    """The acceptance criterion's memory_analysis form: the compiled
+    forward's WEIGHT argument bytes halve under bf16 variables and
+    quarter under int8 (measured net of the input-batch argument)."""
+
+    def test_compiled_forward_weight_bytes(self, trained):
+        import jax.numpy as jnp
+
+        from distributedpytorch_tpu.models.unet import UNet
+        from distributedpytorch_tpu.serve.infer import make_forward
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.random((2, H, W, 3), dtype=np.float32))
+        batch_bytes = x.size * 4
+
+        def weight_arg_bytes(model, variables, quantized):
+            fwd = jax.jit(make_forward(model, quantized=quantized))
+            compiled = fwd.lower(variables, x).compile()
+            ma = compiled.memory_analysis()
+            if ma is None:  # pragma: no cover — backend without analysis
+                pytest.skip("memory_analysis unavailable")
+            return int(ma.argument_size_in_bytes) - batch_bytes
+
+        model32 = UNet(dtype=jnp.float32, widths=WIDTHS, s2d_levels=0)
+        params = model32.init(jax.random.key(0), jnp.zeros((1, H, W, 3)))[
+            "params"
+        ]
+        b32 = weight_arg_bytes(model32, {"params": params}, False)
+        b16 = weight_arg_bytes(
+            model32,
+            {"params": jax.tree.map(
+                lambda p: p.astype(jnp.bfloat16), params
+            )},
+            False,
+        )
+        bq = weight_arg_bytes(
+            model32, {"params": quant.quantize_tree(params)}, True
+        )
+        assert b16 / b32 == pytest.approx(0.5, abs=0.05)
+        assert bq / b32 < 0.3, (bq, b32)

@@ -1,0 +1,220 @@
+#!/usr/bin/env python3
+"""Precision-policy A/B: f32 vs bf16 vs bf16_params (+ the int8 serve
+forward) — imgs/s and memory at a fixed batch.
+
+The measurement side of docs/PERFORMANCE.md "Precision". Per policy, one
+cell compiles the REAL train step (train/steps.make_train_step under the
+policy, the exact step the trainer jits) at a fixed batch and records:
+
+* ``step_ms`` / ``imgs_per_sec`` — the MXU claim: on TPU, bf16 conv
+  compute roughly doubles throughput over f32; bf16_params should match
+  bf16 (same compute dtype — it changes storage, not math);
+* XLA ``memory_analysis`` bytes — ``argument_bytes`` (the resident
+  state+batch the executable binds: bf16_params' params halve but its
+  f32 master adds back in opt state — the honest training-side number)
+  and ``temp_bytes`` (activation liveness, set by the compute dtype);
+* ``param_bytes`` — the on-device param storage alone (the halving
+  bf16_params actually buys, and what FSDP all-gathers).
+
+A final pair of cells compiles the SERVE forward (serve/infer
+make_forward) over f32 vs int8 weights-only variables and records the
+weight-argument bytes — the quartering ``serve --quantize int8`` buys.
+
+Callable in-process (``dtype_sweep(budget_s=...)``) — registered as the
+``dtype_sweep`` bench_multi config (budget-aware, behind the static
+preflight's no-combos fast path: single-device, collective-free).
+
+Usage: python tools/bench_dtype.py [--batch 4] [--hw 640 960]
+       [--widths 32 64 128 256] [--steps 5] [--json out.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+POLICY_GRID = ("f32", "bf16", "bf16_params")
+
+
+def dtype_sweep(
+    batch: int = 4,
+    hw=(64, 96),
+    widths=(8, 16),
+    steps: int = 3,
+    policies=POLICY_GRID,
+    budget_s: float = 0.0,
+    emit=None,
+) -> dict:
+    """The policy grid at fixed batch. Returns a summary dict (also the
+    bench_multi row) and emits one dict per cell through ``emit``.
+    ``budget_s`` > 0 stops opening new cells near the wall budget —
+    already-measured cells keep their rows (the chip-window contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedpytorch_tpu.models.unet import UNet
+    from distributedpytorch_tpu.ops.precision import get_policy, param_bytes
+    from distributedpytorch_tpu.train.steps import (
+        create_train_state,
+        make_train_step,
+    )
+
+    t_start = time.monotonic()
+    h, w = hw
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.random((batch, h, w, 3), dtype=np.float32),
+        "mask": (rng.random((batch, h, w)) > 0.5).astype(np.int32),
+    }
+    rows, cells = [], []
+    for name in policies:
+        if budget_s and time.monotonic() - t_start > 0.7 * budget_s:
+            rows.append({"kind": "dtype_cell", "policy": name,
+                         "skipped": "budget"})
+            continue
+        policy = get_policy(name)
+        model = UNet(dtype=policy.compute_dtype, widths=tuple(widths))
+        params = model.init(
+            jax.random.key(0), jnp.zeros((1, h, w, 3))
+        )["params"]
+        state, tx = create_train_state(params, 1e-4, policy=policy)
+        step = jax.jit(make_train_step(model, tx, batch_size=batch,
+                                       policy=policy))
+        placed = {k: jnp.asarray(v) for k, v in batch_np.items()}
+        t0 = time.monotonic()
+        compiled = step.lower(state, placed).compile()
+        compile_s = time.monotonic() - t0
+        ma = compiled.memory_analysis()
+        row = {
+            "kind": "dtype_cell", "policy": name, "batch": batch,
+            "hw": list(hw), "compile_s": round(compile_s, 2),
+            "param_bytes": param_bytes(state.params),
+            "state_bytes": param_bytes((state.params, state.opt_state)),
+            "argument_bytes": int(ma.argument_size_in_bytes) if ma else None,
+            "temp_bytes": int(ma.temp_size_in_bytes) if ma else None,
+        }
+        try:
+            out = compiled(state, placed)
+            jax.block_until_ready(out)
+            state2, _loss = out
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = compiled(state2, placed)
+                state2 = out[0]
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / steps
+            row["step_ms"] = round(dt * 1e3, 1)
+            row["imgs_per_sec"] = round(batch / dt, 1)
+        except Exception as exc:  # noqa: BLE001 — recorded, cell survives
+            row["exec_error"] = f"{type(exc).__name__}: {exc}"
+        rows.append(row)
+        cells.append(row)
+        if emit is not None:
+            emit(row)
+
+    # -- serve-forward weight bytes: f32 vs int8 weights-only ---------------
+    if budget_s and time.monotonic() - t_start > 0.85 * budget_s:
+        # same explicit marker the policy cells emit — a consumer must
+        # be able to tell "not measured this run" from "not produced"
+        for label in ("serve_f32", "serve_int8"):
+            rows.append({"kind": "dtype_cell", "policy": label,
+                         "skipped": "budget"})
+    else:
+        from distributedpytorch_tpu.ops.quant import quantize_tree
+        from distributedpytorch_tpu.serve.infer import make_forward
+
+        model32 = UNet(dtype=jnp.float32, widths=tuple(widths))
+        params32 = model32.init(
+            jax.random.key(0), jnp.zeros((1, h, w, 3))
+        )["params"]
+        x = jnp.asarray(batch_np["image"])
+        batch_bytes = int(x.size) * 4
+        for label, variables, quantized in (
+            ("serve_f32", {"params": params32}, False),
+            ("serve_int8", {"params": quantize_tree(params32)}, True),
+        ):
+            fwd = jax.jit(make_forward(model32, quantized=quantized))
+            compiled = fwd.lower(variables, x).compile()
+            ma = compiled.memory_analysis()
+            row = {
+                "kind": "dtype_cell", "policy": label,
+                "weight_arg_bytes": (
+                    int(ma.argument_size_in_bytes) - batch_bytes
+                    if ma else None
+                ),
+            }
+            rows.append(row)
+            cells.append(row)
+            if emit is not None:
+                emit(row)
+
+    by = {r["policy"]: r for r in cells}
+    summary = {"kind": "dtype_sweep", "batch": batch, "hw": list(hw),
+               "widths": list(widths), "rows": rows}
+    f32 = by.get("f32")
+    for name in ("bf16", "bf16_params"):
+        r = by.get(name)
+        if f32 and r and r.get("step_ms") and f32.get("step_ms"):
+            summary[f"{name}_speedup_vs_f32"] = round(
+                f32["step_ms"] / r["step_ms"], 2)
+        if f32 and r and r.get("param_bytes"):
+            summary[f"{name}_param_bytes_ratio"] = round(
+                r["param_bytes"] / f32["param_bytes"], 3)
+    sf, sq = by.get("serve_f32"), by.get("serve_int8")
+    if sf and sq and sf.get("weight_arg_bytes") and sq.get("weight_arg_bytes"):
+        summary["int8_weight_bytes_ratio"] = round(
+            sq["weight_arg_bytes"] / sf["weight_arg_bytes"], 3)
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4)
+    ap.add_argument("--hw", type=int, nargs=2, default=(640, 960),
+                    help="(H, W) — default the reference geometry")
+    ap.add_argument("--widths", type=int, nargs="+",
+                    default=(32, 64, 128, 256))
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--json", default=None,
+                    help="also append JSON lines to this file")
+    args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    records = []
+
+    def emit(rec):
+        records.append(rec)
+        line = json.dumps(rec)
+        print(line)
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(line + "\n")
+
+    summary = dtype_sweep(
+        batch=args.batch, hw=tuple(args.hw), widths=tuple(args.widths),
+        steps=args.steps, emit=emit,
+    )
+    emit({k: v for k, v in summary.items() if k != "rows"})
+
+    print("\n| policy | step ms | imgs/s | param bytes | state bytes "
+          "| temp bytes |")
+    print("|---|---|---|---|---|---|")
+    for r in records:
+        if r.get("kind") != "dtype_cell" or "step_ms" not in r:
+            continue
+        print(f"| {r['policy']} | {r['step_ms']} | {r['imgs_per_sec']} "
+              f"| {r['param_bytes']} | {r['state_bytes']} "
+              f"| {r.get('temp_bytes')} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,6 +84,16 @@ CONFIGS = [
     # nothing to check for it (see _preflight_combos). Budget covers
     # per-bucket×replica AOT compiles + ~7 bounded measurement legs.
     ("serve_bench", {"BENCH_SERVE": "1"}, 600.0),
+    # Precision-policy A/B (tools/bench_dtype.py): f32 vs bf16 vs
+    # bf16_params train-step imgs/s + memory_analysis bytes at fixed
+    # batch, plus the serve-forward f32-vs-int8 weight-argument bytes —
+    # the measurement row behind the --dtype default and the ≥50 imgs/s
+    # chase (bf16 conv compute ≈2x on the MXU). Safe compile class (the
+    # default train step at the default geometry, three dtype variants);
+    # single-device, collective-free → the static preflight has nothing
+    # to check (no-combos fast path, like serve_bench). Budget covers 3
+    # train-step compiles + 2 forward compiles + bounded timed steps.
+    ("dtype_sweep", {"BENCH_DTYPE_SWEEP": "1"}, 900.0),
     # taps scoped to the top s2d level only (320x480 planes = 153600 px;
     # the next level down is 38400): where the tall-contraction win
     # concentrates, at a severalfold smaller XLA graph than full taps —
@@ -361,6 +371,18 @@ def _run_one(bench, name: str, env: dict, budget: float) -> dict:
             from tools.bench_serve import run_bench
 
             return run_bench(budget_s=budget)
+        if env.get("BENCH_DTYPE_SWEEP") == "1":
+            # precision-policy grid (tools/bench_dtype.py) at the
+            # reference geometry — in-process, budget-aware
+            from tools.bench_dtype import dtype_sweep
+
+            return dtype_sweep(
+                batch=int(env.get("BENCH_BATCH", 4)),
+                hw=(int(env.get("BENCH_H", 640)), int(env.get("BENCH_W", 960))),
+                widths=(32, 64, 128, 256),
+                steps=5,
+                budget_s=budget,
+            )
         # run() reads the lever envs itself but takes batch/arch/geometry
         # from module globals frozen at bench import — re-derive them here.
         bench.BATCH = int(env.get("BENCH_BATCH", 4))

@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Convert a trained checkpoint to weights-only int8 for the serving tier.
+
+The offline half of ``serve --quantize int8`` (ops/quant.py has the
+scheme: per-output-channel symmetric scales, kernels only — biases and
+BatchNorm statistics stay f32). Quantizing once here instead of on every
+server start saves the per-startup conversion AND pins provenance: the
+output's manifest records the SOURCE checkpoint path and sha256, so a
+serving host can always answer "which float weights produced these
+ints". The output file carries the same integrity footer as native
+checkpoints (a torn copy is detected at load, not served).
+
+Usage:
+    python tools/quantize.py -c singleGPU -o checkpoints/singleGPU.int8.ckpt
+    python tools/quantize.py -c ckpts/run.ckpt --model milesial \\
+        --model-widths 64 128 256 512 1024 -o run.int8.ckpt
+
+Then:
+    python -m distributedpytorch_tpu serve -c checkpoints/singleGPU.int8.ckpt \\
+        --quantize int8 ...
+
+The model-identity flags must match the trained checkpoint, exactly like
+predict.py's / serve's — all three resolve weights through
+serve/infer.load_params_for_inference. A Dice A/B against the float
+checkpoint is pinned in tests/test_quantize.py; rerun your own with
+tools/bench_serve.py against both files when the stakes warrant it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+logger = logging.getLogger(__name__)
+
+
+def get_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Quantize a checkpoint to weights-only int8 "
+                    "(per-out-channel symmetric) for serving"
+    )
+    ap.add_argument("--checkpoint", "-c", required=True,
+                    help="Source checkpoint name (e.g. singleGPU) or path "
+                         "(.ckpt/.pth)")
+    ap.add_argument("--checkpoint-dir", default="./checkpoints")
+    ap.add_argument("--out", "-o", default=None,
+                    help="Output path (default: <source>.int8.ckpt)")
+    ap.add_argument("--image-size", type=int, nargs=2, default=(960, 640),
+                    metavar=("W", "H"),
+                    help="Geometry used to build the weight template "
+                         "(must match training, like predict.py)")
+    ap.add_argument("--model", dest="model_arch", default="unet",
+                    choices=["unet", "milesial"])
+    ap.add_argument("--model-widths", type=int, nargs="+", default=None)
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = get_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+    from distributedpytorch_tpu.ops import quant
+    from distributedpytorch_tpu.serve.infer import load_params_for_inference
+
+    src = resolve_checkpoint(args.checkpoint, args.checkpoint_dir)
+    if quant.peek_quantized(src) is not None:
+        logger.error("%s is already an int8 weights file", src)
+        return 2
+    w, h = args.image_size
+    cfg = TrainConfig(
+        model_arch=args.model_arch,
+        model_widths=tuple(args.model_widths) if args.model_widths else None,
+        # template build only — the quantizer never runs the model, so
+        # the execution-domain lever is irrelevant; 0 keeps odd sizes legal
+        s2d_levels=0,
+    )
+    model, _ = create_model(cfg)
+    params, model_state = load_params_for_inference(src, model, input_hw=(h, w))
+    qtree = quant.quantize_tree(params)
+    err = quant.quantization_error(params, qtree)
+    out = args.out or (
+        src[: -len(".ckpt")] + ".int8.ckpt" if src.endswith(".ckpt")
+        else src + ".int8.ckpt"
+    )
+    manifest = {
+        "source": os.path.abspath(src),
+        "source_sha256": quant.file_sha256(src),
+        "model_arch": args.model_arch,
+        "model_widths": list(args.model_widths) if args.model_widths else None,
+        "image_size": [int(w), int(h)],
+    }
+    quant.save_quantized(out, qtree, manifest, model_state=model_state)
+    from distributedpytorch_tpu.ops.precision import param_bytes
+
+    import jax
+
+    f32_bytes = param_bytes(params)
+    int8_bytes = sum(
+        leaf.nbytes
+        for leaf in jax.tree.leaves(qtree)
+        if hasattr(leaf, "nbytes")
+    )
+    logger.info(
+        "wrote %s: %d -> %d weight bytes (%.2fx), max rounding error "
+        "%.3f scale units (bound 0.5), source sha256 %.12s…",
+        out, f32_bytes, int8_bytes, f32_bytes / max(1, int8_bytes), err,
+        manifest["source_sha256"],
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
