@@ -13,7 +13,14 @@ analyzer:
 which runs dptlint (analysis/: jaxpr collective checker + SPMD source
 lint; docs/ANALYSIS.md) on a self-provisioned CPU mesh — the CI
 ``lint-distributed`` gate and the bench/elastic preflights call this —
-and the serving tier:
+the parallelism auto-planner:
+
+    python -m distributedpytorch_tpu plan --out plan.json
+
+which searches strategy × schedule × memory levers with zero device
+execution and emits a ranked plan file for ``bench_multi --plan``
+(analysis/planner.py, docs/PERFORMANCE.md "Planning") — and the
+serving tier:
 
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008
 
@@ -32,6 +39,10 @@ def main() -> None:
         from distributedpytorch_tpu.analysis.cli import main as analyze_main
 
         sys.exit(analyze_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "plan":
+        from distributedpytorch_tpu.analysis.planner import main as plan_main
+
+        sys.exit(plan_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         from distributedpytorch_tpu.serve.cli import main as serve_main
 

@@ -38,6 +38,19 @@ EXIT_FINDINGS = 1
 EXIT_INFRA = 2
 
 
+def _fingerprint_world(text: str) -> int:
+    """0 (off) or >= 2 simulated ranks — a world of 1 has nothing to
+    compare, and silently skipping the desync gate while reporting clean
+    is exactly the false confidence the gate exists to prevent."""
+    n = int(text)
+    if n != 0 and n < 2:
+        raise argparse.ArgumentTypeError(
+            f"--fingerprint-world needs 0 (off) or >= 2 simulated ranks "
+            f"to compare, got {n}"
+        )
+    return n
+
+
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m distributedpytorch_tpu analyze",
@@ -59,6 +72,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="Also verify the optimized-HLO comms contract "
                          "(AOT CPU compile per combo; slower, still zero "
                          "execution)")
+    ap.add_argument("--fingerprint-world", type=_fingerprint_world,
+                    default=0, metavar="N",
+                    help="Trace each combo's train step under N "
+                         "simulated process identities and compare the "
+                         "ordered-collective fingerprints (the "
+                         "multi-process launch preflight's gloo-desync "
+                         "gate — catches collectives gated on ranks the "
+                         "dual-rank re-trace never simulates); "
+                         "0 = off, needs N >= 2")
     ap.add_argument("--no-rank-check", action="store_true",
                     help="Skip the simulated-rank re-trace (halves trace "
                          "count; the dual-rank check is what catches "
@@ -77,9 +99,17 @@ def build_parser() -> argparse.ArgumentParser:
 def run(argv: Optional[Sequence[str]] = None) -> int:
     """The provisioned body: parse, analyze, report."""
     args = build_parser().parse_args(argv)
+    if args.fingerprint_world >= 2 and args.layer == "lint":
+        # the desync gate lives in the collectives layer; silently
+        # skipping a gate the operator explicitly asked for is the
+        # false confidence _fingerprint_world exists to prevent
+        print("analyze: --fingerprint-world requires the collectives "
+              "layer (--layer all|collectives)", file=sys.stderr)
+        return EXIT_INFRA
     t0 = time.monotonic()
     findings: List = []
     combos: List[str] = []
+    fingerprints: dict = {}
     lint_files = 0
     try:
         if args.layer in ("all", "collectives"):
@@ -92,6 +122,13 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
                 rank_check=not args.no_rank_check,
             )
             findings += cfindings
+            if args.fingerprint_world >= 2:
+                ffindings, fingerprints = collectives.fingerprint_combos(
+                    strategies=args.strategies,
+                    schedules=args.schedules,
+                    world=args.fingerprint_world,
+                )
+                findings += ffindings
         if args.layer in ("all", "lint"):
             from distributedpytorch_tpu.analysis import lint
 
@@ -106,6 +143,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         "clean": not findings,
         "findings": [dataclasses.asdict(f) for f in findings],
         "combos": combos,
+        "fingerprints": fingerprints,
         "lint_files": lint_files,
         "hlo": bool(args.hlo),
         "duration_s": round(time.monotonic() - t0, 2),

@@ -175,6 +175,7 @@ class Collective:
     consumer_stages: Tuple[int, ...]  # stages whose conds consume the output
     direct_output: bool               # results are body outputs (grad psum)
     axis_guarded: bool                # inside an axis_index-dependent branch
+    payload_bytes: int = 0            # summed operand aval bytes (per device)
 
     @property
     def signature(self) -> Tuple:
@@ -201,6 +202,24 @@ def _subjaxprs(value) -> List:
 
 def _is_literal(v) -> bool:
     return hasattr(v, "val") and not hasattr(v, "count")
+
+
+def _payload_bytes(eqn) -> int:
+    """Summed operand abstract-value bytes of one collective eqn —
+    inside a ``shard_map`` body the avals are per-device shard shapes,
+    so this is the per-device payload the planner's comms tables want."""
+    total = 0
+    for var in eqn.invars:
+        aval = getattr(var, "aval", None)
+        shape = getattr(aval, "shape", None)
+        dtype = getattr(aval, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        size = 1
+        for dim in shape:
+            size *= int(dim)
+        total += size * dtype.itemsize
+    return int(total)
 
 
 def _body_attribution(jaxpr):
@@ -315,6 +334,7 @@ def extract_collectives(closed_jaxpr) -> List[Collective]:
                             ov in body_outs for ov in eqn.outvars
                         ),
                         axis_guarded=guarded,
+                        payload_bytes=_payload_bytes(eqn),
                     )
                 )
                 continue
@@ -579,6 +599,86 @@ def check_rank_invariance(method: str, schedule: Optional[str],
     )]
 
 
+# -- collective fingerprints (the multi-process preflight's desync gate) ----
+def collective_fingerprint(method: str, schedule: Optional[str] = None,
+                           process_index: int = 0) -> str:
+    """A short stable hash of one combo's ORDERED collective program —
+    kind, axes, permutation, enclosing-eqn context, and per-device
+    payload bytes of every collective, in program order — traced under
+    the given simulated process identity. Two ranks whose fingerprints
+    differ would trace different programs in a real launch and desync
+    the gloo rendezvous at the first unmatched collective."""
+    import hashlib
+
+    import jax
+
+    with unittest.mock.patch.object(
+        jax, "process_index", lambda: int(process_index)
+    ):
+        colls = extract_collectives(trace_train(method, schedule))
+    payload = repr([(c.signature, c.payload_bytes) for c in colls])
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def check_collective_fingerprints(
+    method: str, schedule: Optional[str], world: int
+) -> Tuple[List[Finding], List[str]]:
+    """Fingerprint one combo under ``world`` simulated ranks and flag
+    any divergence (rule ``collective-fingerprint``). This generalizes
+    the dual-rank re-trace to the job's ACTUAL world size: a collective
+    gated on ``process_index() == 2`` traces identically on ranks 0 and
+    1 — invisible to ``rank-divergent-collective`` — but desyncs a
+    3-process launch; here it is caught before any rank spawns."""
+    if method in PIPELINE_STRATEGIES and schedule is None:
+        schedule = "gpipe"
+    fps = [
+        collective_fingerprint(method, schedule, r) for r in range(world)
+    ]
+    if len(set(fps)) <= 1:
+        return [], fps
+    divergent = sorted({r for r in range(world) if fps[r] != fps[0]})
+    return [Finding(
+        rule="collective-fingerprint",
+        where=_combo_tag(method, schedule, "train"),
+        message=(
+            f"ordered-collective fingerprint diverges at simulated "
+            f"rank(s) {divergent} of world {world} (rank 0: {fps[0]}) — "
+            f"a Python-level rank conditional reaches a collective on "
+            f"only some ranks, so a real {world}-process launch would "
+            f"desync the gloo rendezvous at the first unmatched "
+            f"collective; make the program identical on every rank"
+        ),
+        layer="collectives",
+    )], fps
+
+
+def fingerprint_combos(
+    strategies: Sequence[str] = ANALYSIS_STRATEGIES,
+    schedules: Sequence[str] = ANALYSIS_SCHEDULES,
+    world: int = 2,
+) -> Tuple[List[Finding], Dict[str, List[str]]]:
+    """(findings, {combo tag: [per-rank fingerprint]}) for every
+    requested combo — what ``analyze --fingerprint-world N`` reports and
+    the elastic launch preflight compares before an N-process spawn.
+
+    Accepted cost: the rank-0 trace here duplicates the one
+    ``analyze_combo`` already ran in the same analyzer invocation (~2 s
+    per combo). The preflight scopes to ONE combo, so the overlap stays
+    a couple of seconds of its 300 s budget; reusing the program would
+    mean threading extraction results through ``analyze``'s public
+    return, which isn't worth it at this cost."""
+    findings: List[Finding] = []
+    table: Dict[str, List[str]] = {}
+    for method, schedule in combos_for(strategies, schedules):
+        tag = f"{method}/{schedule}" if schedule else method
+        combo_findings, fps = check_collective_fingerprints(
+            method, schedule, world
+        )
+        findings += combo_findings
+        table[tag] = fps
+    return dedupe(findings), table
+
+
 # -- HLO tier (opt-in: AOT compile, still zero execution) --------------------
 _HLO_COLLECTIVE_NAMES = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
@@ -586,33 +686,43 @@ _HLO_COLLECTIVE_NAMES = (
 )
 
 
-def hlo_collectives(method: str, schedule: Optional[str] = None) -> set:
-    """Collective op names in the optimized HLO of the strategy's
-    compiled train step. Ahead-of-time: inputs are ShapeDtypeStructs
-    carrying the strategy's shardings, so the GSPMD partitioner runs but
-    nothing executes and no device memory is committed."""
+def compile_train_step_aot(strategy, model, tx, state, batch):
+    """AOT-compile the strategy's jitted train step over sharding-pinned
+    ``ShapeDtypeStruct``s — the GSPMD partitioner runs, nothing executes,
+    no device memory is committed. THE pin-and-compile rig, shared by the
+    ``--hlo`` contract tier here and the auto-planner's memory/flops
+    probe (analysis/planner.py): a change to how a strategy's state or
+    batch shardings are pinned must reach both, or plans would silently
+    rank a wrongly-pinned program."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    strategy, model, state, tx, batch = _build(method, schedule)
     mesh = strategy.mesh
-    if mesh is None:
-        return set()
+    if mesh is not None:
+        leaf_spec = getattr(strategy, "_leaf_spec", lambda shape: P())
 
-    leaf_spec = getattr(strategy, "_leaf_spec", lambda shape: P())
+        def with_sharding(leaf, spec):
+            return jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+            )
 
-    def with_sharding(leaf, spec):
-        return jax.ShapeDtypeStruct(
-            leaf.shape, leaf.dtype, sharding=NamedSharding(mesh, spec)
+        state = jax.tree.map(
+            lambda x: with_sharding(x, leaf_spec(x.shape)), state
         )
+        batch = {
+            k: with_sharding(v, strategy.batch_sharding.spec)
+            for k, v in batch.items()
+        }
+    return strategy.build_train_step(model, tx).lower(state, batch).compile()
 
-    state = jax.tree.map(lambda x: with_sharding(x, leaf_spec(x.shape)), state)
-    batch = {
-        k: with_sharding(v, strategy.batch_sharding.spec)
-        for k, v in batch.items()
-    }
-    compiled = strategy.build_train_step(model, tx).lower(
-        state, batch).compile()
+
+def hlo_collectives(method: str, schedule: Optional[str] = None) -> set:
+    """Collective op names in the optimized HLO of the strategy's
+    compiled train step (ahead-of-time via ``compile_train_step_aot``)."""
+    strategy, model, state, tx, batch = _build(method, schedule)
+    if strategy.mesh is None:
+        return set()
+    compiled = compile_train_step_aot(strategy, model, tx, state, batch)
     text = compiled.as_text()
     return {name for name in _HLO_COLLECTIVE_NAMES if name in text}
 

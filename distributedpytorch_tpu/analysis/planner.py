@@ -1,0 +1,695 @@
+"""The compiler-driven parallelism auto-planner: ``python -m
+distributedpytorch_tpu plan``.
+
+Chip windows r03–r05 spent most of their budget discovering configs that
+were statically broken or memory-infeasible — facts that never needed a
+device. This module learns them from the compiler alone (Alpa/FlexFlow's
+search-with-a-cost-model idea, scoped to this repo's levers): enumerate
+(strategy × pipeline-schedule × microbatches × s2d level × remat × batch
+× dtype policy), then for each point
+
+1. **static feasibility** — the existing jaxpr collective checker
+   (``analysis/collectives.analyze_combo``, including the dual-rank
+   re-trace): a point whose schedule deadlocks, drops a contract psum,
+   or diverges across ranks is rejected before anything compiles;
+2. **memory feasibility** — AOT-compile the strategy's REAL train step
+   (``strategy.build_train_step`` over sharding-pinned
+   ``ShapeDtypeStruct``s — the GSPMD partitioner runs, nothing
+   executes) and reject points whose ``memory_analysis()`` traced
+   liveness exceeds the ``--hbm-gb`` budget — the same traced-liveness
+   signal PR 4 proved predicts the activation wall;
+3. **rank the survivors** — ``analysis/cost_model.point_cost`` over the
+   compiled flops (``cost_analysis``; guarded — some backends lack it),
+   the liveness bytes, and the comms program (extracted from the jaxpr
+   with per-collective payload bytes for the explicit schedules;
+   analytic for GSPMD strategies, where ``--dtype bf16_params`` halves
+   FSDP's all-gather bytes).
+
+Everything runs on a self-provisioned virtual CPU mesh (same dance as
+the ``analyze`` CLI): zero device execution, zero chip involvement, safe
+to run while a window is idle or from a laptop.
+
+The output is a versioned JSON plan file. ``tools/bench_multi.py
+--plan`` orders its chip-window legs by the plan's predicted rank
+(``rank_legs`` below maps a bench leg's env levers onto plan points) and
+stamps ``plan_rank``/``plan_cost_s`` into each leg row's provenance;
+``tools/tpu_perf_program3.sh`` generates and passes the plan so a window
+spends its first minutes on predicted winners.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import itertools
+import json
+import math
+import os
+import sys
+import time
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from distributedpytorch_tpu.analysis import (
+    ANALYSIS_STRATEGIES,
+    AnalysisEnvironmentError,
+    MESH_DEVICES,
+    PROVISIONED_SENTINEL as _SENTINEL,
+)
+from distributedpytorch_tpu.analysis import cost_model as cm
+# import-light at module level (no jax): safe on bench_multi's jax-free
+# load_plan/rank_legs path
+from distributedpytorch_tpu.analysis.collectives import PIPELINE_STRATEGIES
+
+#: Plan-file schema version: bench_multi refuses (degrades to its own
+#: ordering) on any other value — a stale plan must never silently
+#: reorder a window.
+PLAN_VERSION = 1
+PLAN_KIND = "dpt_plan"
+
+#: The default search grid. Axes that don't apply to a strategy collapse
+#: (schedule/microbatches are pipeline-only), so the default enumerates
+#: singleGPU·(s2d × remat × batch × dtype) + MP·(everything). Trim with
+#: the CLI flags — every point costs one AOT compile (~tens of seconds
+#: at the reference geometry on CPU), so ``--budget-s`` matters.
+DEFAULT_GRID: Dict[str, tuple] = {
+    "strategies": ("singleGPU", "MP"),
+    "schedules": ("gpipe", "1f1b"),
+    "microbatches": (2, 8),
+    "s2d_levels": (0, 2, 3),
+    "remats": (False, True),
+    "batches": (4, 8),
+    "dtypes": ("bf16", "bf16_params"),
+}
+
+EXIT_CLEAN = 0
+EXIT_INFRA = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanPoint:
+    """One candidate configuration — the search space's coordinates."""
+
+    strategy: str
+    schedule: Optional[str]      # None for non-pipeline strategies
+    microbatches: Optional[int]  # None for non-pipeline strategies
+    s2d_levels: int
+    remat: bool
+    batch: int
+    dtype: str
+
+    @property
+    def key(self) -> str:
+        sched = f"/{self.schedule}/m{self.microbatches}" if self.schedule else ""
+        remat = "on" if self.remat else "off"
+        return (f"{self.strategy}{sched}/s2d{self.s2d_levels}"
+                f"/remat-{remat}/b{self.batch}/{self.dtype}")
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["key"] = self.key
+        return d
+
+
+def enumerate_points(
+    strategies: Sequence[str],
+    schedules: Sequence[str],
+    microbatches: Sequence[int],
+    s2d_levels: Sequence[int],
+    remats: Sequence[bool],
+    batches: Sequence[int],
+    dtypes: Sequence[str],
+) -> List[PlanPoint]:
+    """The cartesian grid with non-applicable axes collapsed. dtype is
+    the innermost axis so a budget-truncated run still covers both
+    policies of the earliest points (the comparison each pair exists
+    for) before opening new strategy corners."""
+    points: List[PlanPoint] = []
+    seen = set()
+    for strategy in strategies:
+        scheds: Sequence[Optional[str]] = (
+            tuple(schedules) if strategy in PIPELINE_STRATEGIES else (None,)
+        )
+        mbs: Sequence[Optional[int]] = (
+            tuple(microbatches) if strategy in PIPELINE_STRATEGIES else (None,)
+        )
+        for sched, m, b, s2d, remat, dt in itertools.product(
+            scheds, mbs, batches, s2d_levels, remats, dtypes
+        ):
+            p = PlanPoint(strategy, sched, m, int(s2d), bool(remat),
+                          int(b), dt)
+            if p not in seen:
+                seen.add(p)
+                points.append(p)
+    return points
+
+
+# -- evaluation --------------------------------------------------------------
+def _point_config(point: PlanPoint, image_size, widths):
+    from distributedpytorch_tpu.config import TrainConfig
+
+    return TrainConfig(
+        train_method=point.strategy,
+        batch_size=point.batch,
+        image_size=tuple(image_size),
+        model_widths=tuple(widths) if widths else None,
+        pipeline_schedule=point.schedule or "gpipe",
+        num_microbatches=point.microbatches or 2,
+        s2d_levels=point.s2d_levels,
+        remat=point.remat,
+        dtype=point.dtype,
+    )
+
+
+def _tree_bytes(tree) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        total += math.prod(leaf.shape) * jnp.dtype(leaf.dtype).itemsize
+    return int(total)
+
+
+def _tree_count(tree) -> int:
+    import jax
+
+    return int(sum(math.prod(leaf.shape) for leaf in jax.tree.leaves(tree)))
+
+
+def _flops_of(compiled) -> Optional[float]:
+    """``cost_analysis()`` flops, guarded: absent/odd-shaped analyses on
+    some backends must degrade the cost model, never crash the plan."""
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — NotImplementedError and friends
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, Mapping):
+        return None
+    flops = analysis.get("flops")
+    try:
+        flops = float(flops)
+    except (TypeError, ValueError):
+        return None
+    return flops if flops > 0 else None
+
+
+def evaluate_point(point: PlanPoint, image_size, widths,
+                   mesh_model: cm.MeshModel, hbm_budget_bytes: int) -> dict:
+    """One point's row: abstract state → jaxpr comms program → AOT
+    compile → memory/flops → cost. Zero device execution throughout
+    (``make_jaxpr`` + ``lower().compile()`` only). Raises on configs the
+    strategy itself rejects — the caller records those as infeasible."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedpytorch_tpu.analysis.collectives import (
+        compile_train_step_aot,
+        extract_collectives,
+    )
+    from distributedpytorch_tpu.models import create_model
+    from distributedpytorch_tpu.ops.optim import adam_l2
+    from distributedpytorch_tpu.parallel import build_strategy
+    from distributedpytorch_tpu.train.steps import TrainState
+
+    cfg = _point_config(point, image_size, widths)
+    strategy = build_strategy(cfg)
+    policy = strategy.policy
+    model, _init_fn = create_model(cfg)
+    width, height = cfg.image_size  # (W, H), the reference convention
+
+    variables = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, height, width, 3))),
+        jax.random.key(0),
+    )
+    params = variables["params"]
+    model_state = variables.get("batch_stats")
+    # mirror train/steps.create_train_state: optimizer init sees the
+    # full-precision params (the master-weight wrapper promotes its copy
+    # from what it is given), THEN params cast to storage dtype
+    tx = adam_l2(cfg.learning_rate, cfg.weight_decay)
+    if policy.master_weights:
+        tx = policy.wrap_optimizer(tx)
+    opt_state = jax.eval_shape(tx.init, params)
+    params = jax.eval_shape(policy.cast_params, params)
+    state = TrainState(
+        params=params,
+        opt_state=opt_state,
+        step=jax.ShapeDtypeStruct((), jnp.int32),
+        model_state=model_state,
+    )
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (point.batch, height, width, 3), jnp.float32),
+        "mask": jax.ShapeDtypeStruct((point.batch, height, width), jnp.int32),
+    }
+
+    # -- comms program: jaxpr-extracted (explicit schedules) or analytic ----
+    mesh = strategy.mesh
+    colls = extract_collectives(
+        jax.make_jaxpr(strategy._raw_step(model, tx))(state, batch)
+    )
+    program: List[cm.CommOp] = []
+    last_sig = None
+    for c in colls:
+        axis_size = 1
+        for axis in c.axes:
+            if isinstance(axis, str) and mesh is not None and axis in mesh.shape:
+                axis_size *= int(mesh.shape[axis])
+        # a tree-typed collective traces one eqn PER LEAF per tick but
+        # ships as ONE fused transfer on hardware: merge adjacent eqns
+        # with identical signatures into a single op (summed payload),
+        # so the per-collective latency term counts ticks, not leaves
+        if program and c.signature == last_sig:
+            kind, payload, n = program[-1]
+            program[-1] = (kind, payload + c.payload_bytes, n)
+        else:
+            program.append((c.kind, c.payload_bytes, axis_size))
+        last_sig = c.signature
+    comms_model = "jaxpr" if program else "none"
+    if not program and mesh is not None:
+        devices = int(np.prod(list(mesh.shape.values())))
+        program = cm.gspmd_comms_program(
+            strategy.name,
+            param_storage_bytes=_tree_bytes(params),
+            grad_bytes=_tree_count(params) * 4,
+            axis_size=devices,
+        )
+        if program:
+            comms_model = "analytic"
+    comms_bytes, comms_s = cm.comms_summary(program, mesh_model)
+
+    # -- AOT compile: traced liveness + flops, nothing executes -------------
+    compiled = compile_train_step_aot(strategy, model, tx, state, batch)
+    ma = compiled.memory_analysis()
+    flops = _flops_of(compiled)
+
+    bytes_row: Dict[str, Optional[int]] = {
+        "temp_bytes": int(ma.temp_size_in_bytes) if ma else None,
+        "argument_bytes": int(ma.argument_size_in_bytes) if ma else None,
+        "output_bytes": int(ma.output_size_in_bytes) if ma else None,
+    }
+    live_bytes = (
+        sum(v for v in bytes_row.values() if v is not None)
+        if ma else None
+    )
+
+    feasible = True
+    reject = None
+    if live_bytes is not None and live_bytes > hbm_budget_bytes:
+        feasible = False
+        reject = (
+            f"memory: traced liveness {live_bytes} B exceeds the "
+            f"{hbm_budget_bytes} B HBM budget "
+            f"(temp={bytes_row['temp_bytes']}, "
+            f"args={bytes_row['argument_bytes']}, "
+            f"out={bytes_row['output_bytes']})"
+        )
+
+    predicted = cm.point_cost(
+        mesh_model, policy.compute, flops, live_bytes, comms_s,
+        hbm_budget_bytes=hbm_budget_bytes,
+    )
+    predicted.update(bytes_row)
+    predicted["live_bytes"] = live_bytes
+    predicted["flops"] = flops
+    predicted["comms_bytes"] = comms_bytes
+    predicted["comms_model"] = comms_model
+    cost = predicted["cost_s"]
+    predicted["imgs_per_s"] = (
+        round(strategy.global_batch_size / cost, 2) if cost else None
+    )
+
+    row = point.as_dict()
+    row.update(feasible=feasible, reject=reject, predicted=predicted)
+    return row
+
+
+def _static_findings(points: Sequence[PlanPoint]) -> Dict[str, List[str]]:
+    """One collective-checker run per distinct (strategy, schedule)
+    among the points — the dual-rank re-trace included, so a
+    ``process_index()``-gated collective rejects here too. Strategies
+    the analyzer doesn't cover (singleGPU) have nothing to check.
+    Analyzer crashes on a combo degrade to 'no findings' for that combo
+    (the planner is advisory; the memory gate still applies)."""
+    from distributedpytorch_tpu.analysis import collectives
+
+    findings: Dict[str, List[str]] = {}
+    combos = sorted(
+        {(p.strategy, p.schedule) for p in points
+         if p.strategy in ANALYSIS_STRATEGIES},
+        key=lambda c: (c[0], c[1] or ""),
+    )
+    for method, schedule in combos:
+        tag = f"{method}/{schedule}" if schedule else method
+        try:
+            found = collectives.analyze_combo(
+                method, schedule, hlo=False, rank_check=True
+            )
+        except Exception as exc:  # noqa: BLE001 — infra, not a finding
+            findings[tag] = []
+            print(f"plan: static check for {tag} could not run "
+                  f"({type(exc).__name__}: {exc}) — proceeding",
+                  file=sys.stderr)
+            continue
+        findings[tag] = [f"[{f.rule}] {f.where}: {f.message}" for f in found]
+    return findings
+
+
+def plan(
+    strategies: Sequence[str] = DEFAULT_GRID["strategies"],
+    schedules: Sequence[str] = DEFAULT_GRID["schedules"],
+    microbatches: Sequence[int] = DEFAULT_GRID["microbatches"],
+    s2d_levels: Sequence[int] = DEFAULT_GRID["s2d_levels"],
+    remats: Sequence[bool] = DEFAULT_GRID["remats"],
+    batches: Sequence[int] = DEFAULT_GRID["batches"],
+    dtypes: Sequence[str] = DEFAULT_GRID["dtypes"],
+    image_size=(960, 640),
+    widths: Optional[Sequence[int]] = None,
+    hbm_gb: float = 16.0,
+    mesh_model: str = "tpu_v5e",
+    budget_s: float = 0.0,
+    emit=None,
+) -> dict:
+    """Search, reject, rank; returns the plan payload (what
+    ``save_plan`` writes). ``budget_s`` > 0 stops opening new compiles
+    near the wall budget — already-evaluated points keep their rows and
+    the rest carry an explicit ``skipped: budget`` marker."""
+    t_start = time.monotonic()
+    mm = MESH_MODELS_LOOKUP(mesh_model)
+    hbm_budget_bytes = int(hbm_gb * 2**30)
+    points = enumerate_points(
+        strategies, schedules, microbatches, s2d_levels, remats, batches,
+        dtypes,
+    )
+    static = _static_findings(points)
+
+    rows: List[dict] = []
+    for point in points:
+        combo = (f"{point.strategy}/{point.schedule}" if point.schedule
+                 else point.strategy)
+        lines = static.get(combo, ())
+        if lines:
+            row = point.as_dict()
+            row.update(feasible=False, reject=f"static: {lines[0]}",
+                       predicted=None)
+        elif budget_s and time.monotonic() - t_start > 0.8 * budget_s:
+            row = point.as_dict()
+            row.update(feasible=None, reject=None, predicted=None,
+                       skipped="budget")
+        else:
+            try:
+                row = evaluate_point(
+                    point, image_size, widths, mm, hbm_budget_bytes
+                )
+            except AnalysisEnvironmentError:
+                # the analyzer's own infra-failure class: a broken
+                # environment must surface as EXIT_INFRA from the CLI,
+                # never be recorded as a confident per-point rejection
+                raise
+            except Exception as exc:  # noqa: BLE001 — strategy/config rejects
+                row = point.as_dict()
+                row.update(
+                    feasible=False,
+                    reject=f"config: {type(exc).__name__}: {exc}",
+                    predicted=None,
+                )
+        rows.append(row)
+        if emit is not None:
+            emit(row)
+
+    # cost_s must be POSITIVE to rank: a backend yielding neither
+    # cost_analysis nor memory_analysis leaves a comms-free point at
+    # 0.0 — completely unmeasured, which must not sort ahead of every
+    # genuinely evaluated point
+    ranked = sorted(
+        (r for r in rows
+         if r.get("feasible")
+         and r.get("predicted")
+         and (r["predicted"].get("cost_s") or 0) > 0),
+        key=lambda r: (r["predicted"]["cost_s"], r["key"]),
+    )
+    for rank, row in enumerate(ranked):
+        row["rank"] = rank
+    for row in rows:
+        row.setdefault("rank", None)
+
+    return {
+        "kind": PLAN_KIND,
+        "version": PLAN_VERSION,
+        "mesh_model": mm.name,
+        "hbm_gb": float(hbm_gb),
+        "image_size": list(image_size),
+        "widths": list(widths) if widths else None,
+        "grid": {
+            "strategies": list(strategies),
+            "schedules": list(schedules),
+            "microbatches": list(microbatches),
+            "s2d_levels": list(s2d_levels),
+            "remats": [bool(r) for r in remats],
+            "batches": list(batches),
+            "dtypes": list(dtypes),
+        },
+        "static_findings": static,
+        "points": rows,
+        "ranking": [r["key"] for r in ranked],
+        "duration_s": round(time.monotonic() - t_start, 2),
+    }
+
+
+def MESH_MODELS_LOOKUP(name: str) -> cm.MeshModel:
+    try:
+        return cm.MESH_MODELS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown mesh model {name!r}; expected one of "
+            f"{sorted(cm.MESH_MODELS)}"
+        ) from None
+
+
+# -- plan-file IO (jax-free: bench_multi imports these) ----------------------
+def save_plan(payload: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
+
+
+def load_plan(path: str) -> Optional[dict]:
+    """The plan file, or None for missing/unreadable/stale — callers
+    (bench_multi ``--plan``) degrade to their own ordering on None; a
+    half-written or version-skewed plan must never reorder a window."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("kind") != PLAN_KIND or payload.get("version") != PLAN_VERSION:
+        return None
+    if not isinstance(payload.get("points"), list):
+        return None
+    return payload
+
+
+# -- bench_multi leg mapping (jax-free) --------------------------------------
+#: The ONLY env levers the planner's search space models. This is an
+#: ALLOWLIST on purpose: a leg carrying any other lever (Pallas/Mosaic
+#: kernels, the serve and dtype sweeps' own grids, compile-only probes,
+#: levers added to bench_multi after this table) is unmodeled and keeps
+#: bench_multi's hand-ordered safety position — an unknown lever must
+#: fail SAFE (unranked), never fall through to the default point and
+#: move a wedge-suspect compile to the front of a chip window.
+_MODELED_LEVERS = frozenset(
+    {"BENCH_S2D_LEVELS", "BENCH_BATCH", "BENCH_ARCH",
+     "BENCH_PIPELINE_SWEEP"}
+)
+
+
+def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
+    """A bench_multi leg's env levers → the plan-point fields it must
+    match, or None for legs the planner doesn't model."""
+    if any(k not in _MODELED_LEVERS for k in env):
+        return None
+    if env.get("BENCH_ARCH", "unet") != "unet":
+        return None
+    if env.get("BENCH_PIPELINE_SWEEP") == "1":
+        # the sweep leg measures a whole M × schedule GRID; its rank is
+        # a best-case proxy (where do MP configs land at all), so only
+        # the strategy is constrained
+        return {"strategy": "MP"}
+    return {
+        "strategy": "singleGPU",
+        "batch": int(env.get("BENCH_BATCH", "4")),
+        # bench.py's s2d auto resolves to 2 on the TPU backend
+        "s2d_levels": int(env.get("BENCH_S2D_LEVELS", "2")),
+        "remat": False,
+        # bench.py hardcodes bf16 compute (no BENCH_DTYPE lever): a
+        # bf16_params point's rank must not stamp a leg that runs bf16
+        "dtype": "bf16",
+    }
+
+
+def rank_legs(payload: dict, configs) -> Dict[str, dict]:
+    """{leg name: {plan_rank, plan_cost_s, plan_point}} for every bench
+    config whose levers match a ranked feasible plan point (a leg is
+    ranked by the BEST point it could run — e.g. its fastest dtype).
+    Legs without a match are simply absent: bench_multi keeps their
+    hand-ordered position."""
+    ranked_points = [
+        p for p in payload.get("points", ())
+        if isinstance(p, dict) and p.get("feasible")
+        # bool is an int subclass; a hand-edited "rank": true must not
+        # sneak in as rank 1
+        and isinstance(p.get("rank"), int)
+        and not isinstance(p.get("rank"), bool)
+    ]
+    out: Dict[str, dict] = {}
+    for name, env, _budget in configs:
+        selector = _leg_selector(env)
+        if selector is None:
+            continue
+        matches = [
+            p for p in ranked_points
+            if all(p.get(k) == v for k, v in selector.items())
+        ]
+        if not matches:
+            continue
+        best = min(matches, key=lambda p: p["rank"])
+        predicted = best.get("predicted") or {}
+        out[name] = {
+            "plan_rank": int(best["rank"]),
+            "plan_cost_s": predicted.get("cost_s"),
+            "plan_point": best.get("key"),
+        }
+    return out
+
+
+# -- CLI ---------------------------------------------------------------------
+def build_parser() -> argparse.ArgumentParser:
+    g = DEFAULT_GRID
+    ap = argparse.ArgumentParser(
+        prog="python -m distributedpytorch_tpu plan",
+        description="Compiler-driven parallelism auto-planner: search "
+        "strategy × schedule × memory levers with zero device execution, "
+        "reject statically-broken / memory-infeasible points, rank the "
+        "rest by an analytic cost model, and emit a plan file for "
+        "bench_multi --plan. See docs/PERFORMANCE.md 'Planning'.",
+    )
+    ap.add_argument("--out", default="plan.json",
+                    help="Plan file to write (versioned JSON)")
+    ap.add_argument("--strategies", nargs="+", default=list(g["strategies"]))
+    ap.add_argument("--schedules", nargs="+", default=list(g["schedules"]),
+                    choices=["gpipe", "1f1b"])
+    ap.add_argument("--microbatches", type=int, nargs="+",
+                    default=list(g["microbatches"]))
+    ap.add_argument("--s2d-levels", type=int, nargs="+",
+                    default=list(g["s2d_levels"]),
+                    help="Explicit levels only: -1 (auto) would resolve "
+                         "against the COMPILING backend, not the chip")
+    ap.add_argument("--remat", choices=["off", "on", "both"], default="both")
+    ap.add_argument("--batches", type=int, nargs="+",
+                    default=list(g["batches"]))
+    ap.add_argument("--dtypes", nargs="+", default=list(g["dtypes"]),
+                    choices=["f32", "bf16", "bf16_params"])
+    ap.add_argument("--image-size", type=int, nargs=2, default=(960, 640),
+                    metavar=("W", "H"),
+                    help="Target geometry (the reference 960 640)")
+    ap.add_argument("--widths", type=int, nargs="+", default=None,
+                    help="Model channel widths (default: the architecture's "
+                         "documented plan)")
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="Per-device HBM budget (default: the mesh "
+                         "model's capacity)")
+    ap.add_argument("--mesh-model", default="tpu_v5e",
+                    choices=sorted(cm.MESH_MODELS))
+    ap.add_argument("--budget-s", type=float, default=0.0,
+                    help="Stop opening new compiles near this wall "
+                         "budget; unevaluated points are marked skipped")
+    return ap
+
+
+def run(argv: Optional[Sequence[str]] = None) -> int:
+    """The provisioned body: parse, plan, write, summarize."""
+    args = build_parser().parse_args(argv)
+    remats = {"off": (False,), "on": (True,), "both": (False, True)}[args.remat]
+    try:
+        mm = MESH_MODELS_LOOKUP(args.mesh_model)
+    except ValueError as exc:
+        print(f"plan: {exc}", file=sys.stderr)
+        return EXIT_INFRA
+    hbm_gb = args.hbm_gb if args.hbm_gb is not None else mm.hbm_gb
+
+    def emit(row):
+        line = {k: row.get(k) for k in ("key", "feasible", "reject")}
+        if row.get("skipped"):
+            line["skipped"] = row["skipped"]
+        predicted = row.get("predicted") or {}
+        if predicted.get("cost_s") is not None:
+            line["cost_s"] = round(predicted["cost_s"], 6)
+        print(json.dumps(line))
+
+    try:
+        payload = plan(
+            strategies=args.strategies,
+            schedules=args.schedules,
+            microbatches=args.microbatches,
+            s2d_levels=args.s2d_levels,
+            remats=remats,
+            batches=args.batches,
+            dtypes=args.dtypes,
+            image_size=tuple(args.image_size),
+            widths=tuple(args.widths) if args.widths else None,
+            hbm_gb=hbm_gb,
+            mesh_model=args.mesh_model,
+            budget_s=args.budget_s,
+            emit=emit,
+        )
+    except Exception as exc:  # noqa: BLE001 — infra failure, distinct rc
+        print(f"plan: infrastructure failure: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return EXIT_INFRA
+    save_plan(payload, args.out)
+
+    rows = payload["points"]
+    feasible = [r for r in rows if r.get("feasible")]
+    rejected = [r for r in rows if r.get("feasible") is False]
+    skipped = [r for r in rows if r.get("skipped")]
+    print(f"\nplan: {len(rows)} points — {len(feasible)} feasible, "
+          f"{len(rejected)} rejected, {len(skipped)} budget-skipped in "
+          f"{payload['duration_s']}s → {args.out}")
+    by_key = {r["key"]: r for r in rows}
+    print("\n| rank | point | predicted cost s | predicted imgs/s |")
+    print("|---|---|---|---|")
+    for key in payload["ranking"][:10]:
+        p = by_key[key]["predicted"]
+        print(f"| {by_key[key]['rank']} | {key} | {p['cost_s']:.6g} "
+              f"| {p['imgs_per_s']} |")
+    for r in rejected[:10]:
+        print(f"rejected: {r['key']}: {r['reject']}")
+    return EXIT_CLEAN
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Self-provisioning entry (the ``plan`` subcommand): exec-replace
+    under an 8-device virtual CPU mesh unless already provisioned —
+    pinned to CPU, never dialing a tunneled TPU runtime, exactly the
+    ``analyze`` CLI's dance."""
+    argv = list(sys.argv[2:] if argv is None else argv)
+    if os.environ.get(_SENTINEL) == "1":
+        return run(argv)
+    from distributedpytorch_tpu.utils.provision import reexec_provisioned_cmd
+
+    reexec_provisioned_cmd(
+        MESH_DEVICES, _SENTINEL,
+        [sys.executable, "-u", "-m", "distributedpytorch_tpu", "plan",
+         *argv],
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

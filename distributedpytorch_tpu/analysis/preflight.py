@@ -34,6 +34,7 @@ def run_preflight(
     layer: str = "collectives",
     base_env: Optional[Mapping[str, str]] = None,
     cwd: Optional[str] = None,
+    fingerprint_world: int = 0,
 ) -> Tuple[int, List[str]]:
     from distributedpytorch_tpu.utils.provision import provisioned_env
 
@@ -42,8 +43,17 @@ def run_preflight(
     cmd = [
         sys.executable, "-m", "distributedpytorch_tpu", "analyze",
         "--layer", layer, "--json", "-",
-        "--strategies", *strategies,
     ]
+    if fingerprint_world and int(fingerprint_world) >= 2:
+        # the multi-process desync gate: compare the ordered-collective
+        # fingerprint under every simulated rank of the job's ACTUAL
+        # world size (docs/ANALYSIS.md `collective-fingerprint`). The
+        # fingerprint comparison covers ranks 0..N-1, so the dual-rank
+        # (0 vs 1) re-trace is subsumed — skip it rather than pay two
+        # redundant traces per combo inside the preflight's timeout.
+        cmd += ["--fingerprint-world", str(int(fingerprint_world)),
+                "--no-rank-check"]
+    cmd += ["--strategies", *strategies]
     if schedules:
         cmd += ["--schedules", *schedules]
     try:

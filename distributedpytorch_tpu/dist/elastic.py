@@ -41,7 +41,12 @@ process CPU/gloo mesh (tests/test_elastic.py):
     until the heartbeat window expires and burn the whole restart budget
     relaunching into the same hang. Findings refuse the launch
     (``STATIC_CHECK_EXIT``); analyzer infra failures never block;
-    ``--no-preflight`` overrides.
+    ``--no-preflight`` overrides. The analyzer also compares the
+    ordered-collective fingerprint under every simulated rank of THIS
+    job's world size (``--fingerprint-world N``, rule
+    ``collective-fingerprint``), so a collective gated on a rank the
+    dual-rank re-trace never simulates is caught before the spawn
+    instead of desyncing the gloo rendezvous.
 
 Chaos drills: ``--chaos SITE[@RANK]:EPOCH:STEP[:COUNT]`` arms a fault
 (utils/faults.py — ``rank_kill`` / ``rank_hang`` live in the step loop)
@@ -490,6 +495,11 @@ class ElasticSupervisor:
         rc, findings = run_preflight(
             [self.method_tag], [schedule], self.preflight_timeout_s,
             layer="collectives", base_env=self.base_env, cwd=self.cwd,
+            # compare each combo's ordered-collective fingerprint under
+            # THIS job's world size: a collective gated on a rank >= 2
+            # passes the dual-rank re-trace but would desync an N-rank
+            # gloo rendezvous — catch it before the first spawn
+            fingerprint_world=self.nprocs,
         )
         if rc == 1:
             return findings

@@ -188,6 +188,85 @@ class TestSeededMutations:
 
 
 # ---------------------------------------------------------------------------
+class TestCollectiveFingerprint:
+    """The ``collective-fingerprint`` rule (ISSUE 10 satellite): a short
+    stable hash of each combo's ORDERED collective program, compared
+    across every simulated rank of the job's world size in the
+    multi-process launch preflight — catching gloo desyncs the dual-rank
+    (0 vs 1) re-trace cannot see, before any rank spawns."""
+
+    def test_stable_across_retraces(self, no_compile):
+        a = collectives.collective_fingerprint("MP", "1f1b")
+        b = collectives.collective_fingerprint("MP", "1f1b")
+        assert a == b and len(a) == 16
+
+    def test_schedules_fingerprint_differently(self, no_compile):
+        assert (collectives.collective_fingerprint("MP", "gpipe")
+                != collectives.collective_fingerprint("MP", "1f1b"))
+
+    def test_clean_tree_matches_across_world(self, no_compile):
+        findings, table = collectives.fingerprint_combos(
+            ["MP"], ["1f1b"], world=3
+        )
+        assert findings == []
+        fps = table["MP/1f1b"]
+        assert len(fps) == 3 and len(set(fps)) == 1
+
+    def test_rank2_gated_collective_needs_world_3(
+        self, monkeypatch, no_compile
+    ):
+        """The gap this rule closes: a collective gated on
+        ``process_index() == 2`` traces identically on simulated ranks
+        0 and 1 (both skip it), so the dual-rank fingerprint pair
+        matches — only fingerprinting the job's ACTUAL world size (3)
+        sees rank 2's divergent program."""
+        orig = pipeline._reduce_grads
+
+        def gated(grads, axes):
+            if jax.process_index() == 2:
+                return orig(grads, axes)
+            return grads
+
+        monkeypatch.setattr(pipeline, "_reduce_grads", gated)
+        f2, table2 = collectives.fingerprint_combos(["MP"], ["1f1b"], 2)
+        assert f2 == []  # ranks 0 and 1 agree — the old check's blind spot
+        assert len(set(table2["MP/1f1b"])) == 1
+        f3, table3 = collectives.fingerprint_combos(["MP"], ["1f1b"], 3)
+        assert [f.rule for f in f3] == ["collective-fingerprint"]
+        assert "rank(s) [2]" in f3[0].message
+        assert "desync" in f3[0].message
+        assert len(set(table3["MP/1f1b"])) == 2
+
+    def test_cli_rejects_world_of_one(self):
+        # a world of 1 has nothing to compare; silently skipping the
+        # gate while reporting clean would be false confidence
+        with pytest.raises(SystemExit):
+            analyze_cli_run(["--fingerprint-world", "1"])
+        with pytest.raises(SystemExit):
+            analyze_cli_run(["--fingerprint-world", "-3"])
+
+    def test_cli_rejects_fingerprint_with_lint_only_layer(self):
+        # --layer lint never runs the collectives layer, so the
+        # requested desync gate would silently not execute — refuse
+        # (rc 2, infra) instead of reporting a false clean
+        rc = analyze_cli_run(
+            ["--layer", "lint", "--fingerprint-world", "2"])
+        assert rc == 2
+
+    def test_cli_reports_fingerprints(self, tmp_path):
+        report = tmp_path / "report.json"
+        rc = analyze_cli_run([
+            "--layer", "collectives", "--strategies", "MP",
+            "--schedules", "1f1b", "--no-rank-check",
+            "--fingerprint-world", "2", "--json", str(report),
+        ])
+        assert rc == 0
+        payload = json.loads(report.read_text())
+        fps = payload["fingerprints"]["MP/1f1b"]
+        assert len(fps) == 2 and fps[0] == fps[1]
+
+
+# ---------------------------------------------------------------------------
 class TestContractTables:
     def test_jaxpr_contract_covers_every_analyzed_combo(self):
         for method, schedule in collectives.combos_for():

@@ -617,6 +617,173 @@ class TestDtypeSweepConfig:
         assert bench_multi.load_state(out) == {"dtype_sweep": "ok"}
 
 
+class TestPlanOrdering:
+    """ISSUE 10: ``--plan`` orders legs by the auto-planner's predicted
+    rank (planned winners first; unmodeled legs keep their hand-ordered
+    safety position), stamps ``plan_rank``/``plan_cost_s`` into the
+    provenance rows, and a missing or stale plan file degrades to the
+    default ordering."""
+
+    _fake_bench = TestMainLoop._fake_bench
+    _patch = TestMainLoop._patch
+
+    CONFIGS = [
+        ("pixel", {"BENCH_S2D_LEVELS": "0"}, 60.0),
+        ("b8", {"BENCH_BATCH": "8"}, 60.0),
+    ]
+
+    def _plan_file(self, tmp_path):
+        from distributedpytorch_tpu.analysis.planner import PLAN_VERSION
+
+        plan = {
+            "kind": "dpt_plan", "version": PLAN_VERSION,
+            "points": [
+                # b8's point predicted fastest, pixel's slowest
+                {"strategy": "singleGPU", "batch": 8, "s2d_levels": 2,
+                 "remat": False, "dtype": "bf16", "feasible": True,
+                 "rank": 0,
+                 "key": "singleGPU/s2d2/remat-off/b8/bf16",
+                 "predicted": {"cost_s": 0.01}},
+                {"strategy": "singleGPU", "batch": 4, "s2d_levels": 0,
+                 "remat": False, "dtype": "bf16", "feasible": True,
+                 "rank": 4,
+                 "key": "singleGPU/s2d0/remat-off/b4/bf16",
+                 "predicted": {"cost_s": 0.05}},
+            ],
+        }
+        path = tmp_path / "plan.json"
+        path.write_text(json.dumps(plan))
+        return str(path)
+
+    def _ordered_bench(self, order):
+        """A fake bench whose run() records which config's levers were
+        active — the execution order probe."""
+        mod = types.SimpleNamespace(BATCH=4, H=640, W=960, ARCH="unet",
+                                    _START=0.0)
+
+        def run():
+            order.append((mod.BATCH, os.environ.get("BENCH_S2D_LEVELS")))
+            return {"value": float(len(order))}
+
+        mod.run = run
+        return mod
+
+    def test_legs_reordered_and_rows_stamped(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, self.CONFIGS)
+        rc = bench_multi.main(
+            ["--out", out, "--plan", self._plan_file(tmp_path)])
+        assert rc == 0
+        # b8 (rank 0) ran before pixel (rank 4) despite CONFIGS order
+        assert order == [(8, None), (4, "0")]
+        rows = {d["config"]: d for d in _lines(out)
+                if d.get("config") and "error" not in d
+                and d.get("event") is None}
+        assert rows["b8"]["plan_rank"] == 0
+        assert rows["b8"]["plan_cost_s"] == 0.01
+        assert rows["b8"]["plan_point"] == "singleGPU/s2d2/remat-off/b8/bf16"
+        assert rows["pixel"]["plan_rank"] == 4
+        start = [d for d in _lines(out)
+                 if d.get("event") == "session_start"][0]
+        assert start["plan"]["legs"] == {"b8": 0, "pixel": 4}
+
+    def test_unmodeled_legs_keep_tail_safety_order(
+            self, tmp_path, monkeypatch):
+        """A wedge-suspect leg the plan cannot model must NOT move
+        earlier — prediction never overrides the compile-safety order."""
+        configs = self.CONFIGS + [
+            ("wgrad_taps", {"BENCH_WGRAD_TAPS": "1"}, 60.0)]
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, configs)
+        rc = bench_multi.main(
+            ["--out", out, "--plan", self._plan_file(tmp_path)])
+        assert rc == 0
+        attempts = [d["config"] for d in _lines(out)
+                    if d.get("event") == "attempting"]
+        assert attempts == ["b8", "pixel", "wgrad_taps"]
+        taps_row = [d for d in _lines(out)
+                    if d.get("config") == "wgrad_taps"
+                    and d.get("event") is None and "error" not in d][0]
+        assert "plan_rank" not in taps_row
+
+    def test_missing_plan_degrades_to_default_order(
+            self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, self.CONFIGS)
+        rc = bench_multi.main(
+            ["--out", out, "--plan", str(tmp_path / "missing.json")])
+        assert rc == 0
+        assert order == [(4, "0"), (8, None)]  # CONFIGS order kept
+        rows = [d for d in _lines(out) if d.get("config")]
+        assert not any("plan_rank" in d for d in rows)
+
+    def test_stale_plan_degrades_to_default_order(
+            self, tmp_path, monkeypatch):
+        from distributedpytorch_tpu.analysis.planner import PLAN_VERSION
+
+        stale = tmp_path / "stale.json"
+        stale.write_text(json.dumps({
+            "kind": "dpt_plan", "version": PLAN_VERSION + 99,
+            "points": [{"strategy": "singleGPU", "batch": 8,
+                        "s2d_levels": 2, "remat": False,
+                        "feasible": True, "rank": 0}],
+        }))
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, self.CONFIGS)
+        rc = bench_multi.main(["--out", out, "--plan", str(stale)])
+        assert rc == 0
+        assert order == [(4, "0"), (8, None)]
+        rows = [d for d in _lines(out) if d.get("config")]
+        assert not any("plan_rank" in d for d in rows)
+
+    def test_semantically_corrupt_plan_degrades_not_crashes(
+            self, tmp_path, monkeypatch):
+        """A plan that passes the schema check but carries garbage point
+        fields (hand edit, torn write) must degrade to the default
+        order — never kill the window driver before session_start."""
+        from distributedpytorch_tpu.analysis.planner import PLAN_VERSION
+
+        bad = tmp_path / "corrupt.json"
+        bad.write_text(json.dumps({
+            "kind": "dpt_plan", "version": PLAN_VERSION,
+            "points": [
+                {"strategy": "singleGPU", "batch": 8, "s2d_levels": 2,
+                 "remat": False, "feasible": True,
+                 "rank": {"oops": "not a number"}},
+                {"strategy": "singleGPU", "batch": 4, "s2d_levels": 0,
+                 "remat": False, "feasible": True, "rank": True},
+            ],
+        }))
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, self.CONFIGS)
+        rc = bench_multi.main(["--out", out, "--plan", str(bad)])
+        assert rc == 0
+        assert order == [(4, "0"), (8, None)]  # default order kept
+        rows = [d for d in _lines(out) if d.get("config")]
+        assert not any("plan_rank" in d for d in rows)
+
+    def test_no_plan_flag_is_unchanged_behavior(self, tmp_path, monkeypatch):
+        out = str(tmp_path / "m.jsonl")
+        order = []
+        mod = self._ordered_bench(order)
+        self._patch(monkeypatch, tmp_path, True, mod, self.CONFIGS)
+        assert bench_multi.main(["--out", out]) == 0
+        assert order == [(4, "0"), (8, None)]
+        start = [d for d in _lines(out)
+                 if d.get("event") == "session_start"][0]
+        assert start["plan"] is None
+
+
 class TestDtypeSweepTool:
     """tools/bench_dtype.py itself on the CPU tier at tiny size: every
     policy cell runs, the memory claims hold (param bytes halved under

@@ -278,6 +278,40 @@ class TestStaticPreflight:
         assert seen["env"]["PALLAS_AXON_POOL_IPS"] == ""
         assert seen["env"]["DPT_ANALYZE_PROVISIONED"] == "1"
 
+    def test_preflight_carries_fingerprint_world(
+        self, tmp_path, monkeypatch
+    ):
+        # the gloo-desync gate (ISSUE 10): the analyzer compares each
+        # combo's ordered-collective fingerprint under every simulated
+        # rank of THIS job's world size — a collective gated on a rank
+        # the dual-rank re-trace never simulates refuses the launch here
+        import distributedpytorch_tpu.analysis.preflight as preflight_mod
+
+        sup = self._sup(
+            tmp_path, nprocs=3,
+            worker_args=["-t", "DDP_MP", "--pipeline-schedule", "1f1b"],
+        )
+        seen = {}
+
+        class Done:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        def fake_run(cmd, env=None, **kw):
+            seen["cmd"] = cmd
+            return Done()
+
+        monkeypatch.setattr(preflight_mod.subprocess, "run", fake_run)
+        assert sup.static_preflight() == []
+        cmd = seen["cmd"]
+        assert cmd[cmd.index("--fingerprint-world") + 1] == "3"
+        # the world-N fingerprint comparison subsumes the dual-rank
+        # (0 vs 1) re-trace — the preflight must not pay both
+        assert "--no-rank-check" in cmd
+        # the strategy/schedule tail stays intact behind the new flags
+        assert cmd[-4:] == ["--strategies", "DDP_MP", "--schedules", "1f1b"]
+
     def test_preflight_follows_abbreviated_schedule_flag(
         self, tmp_path, monkeypatch
     ):

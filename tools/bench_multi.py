@@ -21,7 +21,15 @@ last, with:
     compile would just re-wedge the next chip window);
   * resume — configs with a successful line are skipped, so the
     watcher can re-fire this program across windows and it only ever
-    spends chip time on innocent unmeasured configs.
+    spends chip time on innocent unmeasured configs;
+  * ``--plan`` — an auto-planner plan file (``python -m
+    distributedpytorch_tpu plan``, docs/PERFORMANCE.md "Planning")
+    reorders the legs it models to predicted-winner-first and stamps
+    ``plan_rank``/``plan_cost_s`` into their provenance rows, so a
+    short window measures the configs the cost model bets on before it
+    dies. Legs the planner cannot model — the Pallas/Mosaic compiles,
+    the sweeps' own grids — KEEP their hand-ordered safety position at
+    the tail: prediction never moves a wedge-suspect compile earlier.
 
 Exit codes (the program wrapper's loop contract):
   0 = every config terminally resolved (measured, poisoned, or failed
@@ -175,6 +183,62 @@ def append_line(path: str, obj: dict) -> None:
         f.write(json.dumps(obj) + "\n")
         f.flush()
         os.fsync(f.fileno())
+
+
+def load_plan_ranks(path: "str | None") -> dict:
+    """{leg name: {plan_rank, plan_cost_s, plan_point}} from an
+    auto-planner plan file (``python -m distributedpytorch_tpu plan``,
+    analysis/planner.py), or {} when no plan was given or the file is
+    missing/unreadable/stale (wrong schema version) — a half-written or
+    version-skewed plan must degrade to the hand-ordered config
+    sequence, never silently reorder a window."""
+    if not path:
+        return {}
+    from distributedpytorch_tpu.analysis.planner import load_plan, rank_legs
+
+    plan = load_plan(path)
+    if plan is None:
+        print(f"bench_multi: plan {path!r} missing or stale — keeping "
+              f"the default config order")
+        return {}
+    try:
+        ranks = rank_legs(plan, CONFIGS)
+    except Exception as exc:  # noqa: BLE001 — semantically-corrupt plan
+        # a plan that passes the schema check but carries garbage point
+        # fields must still degrade, never crash the window driver
+        print(f"bench_multi: plan {path!r} unreadable "
+              f"({type(exc).__name__}: {exc}) — keeping the default "
+              f"config order")
+        return {}
+    print(f"bench_multi: plan {path!r} ranks {len(ranks)} of "
+          f"{len(CONFIGS)} configs: "
+          + ", ".join(f"{n}#{d['plan_rank']}"
+                      for n, d in sorted(ranks.items(),
+                                         key=lambda kv: kv[1]["plan_rank"])))
+    return ranks
+
+
+def order_by_plan(todo, plan_ranks: dict):
+    """Planned legs first, best predicted rank first; legs the planner
+    does not model (Pallas/Mosaic compiles, the sweeps' own grids) keep
+    their hand-ordered SAFETY position after the ranked ones — the
+    wedge-suspect compiles stay last no matter what the plan says."""
+    if not plan_ranks:
+        return todo
+    ranked = sorted(
+        (t for t in todo if t[0] in plan_ranks),
+        key=lambda t: plan_ranks[t[0]]["plan_rank"],
+    )
+    return ranked + [t for t in todo if t[0] not in plan_ranks]
+
+
+def _plan_provenance(plan_ranks: dict, name: str) -> dict:
+    info = plan_ranks.get(name)
+    if not info:
+        return {}
+    return {"plan_rank": info["plan_rank"],
+            "plan_cost_s": info.get("plan_cost_s"),
+            "plan_point": info.get("plan_point")}
 
 
 def supervisor_restarts(path: str = "") -> "int | None":
@@ -408,12 +472,23 @@ def main(argv=None) -> int:
     ap.add_argument("--out", default=os.path.join(
         repo, ".perf_r05", "bench_multi.jsonl"))
     ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument("--plan", default=None, metavar="PLAN_JSON",
+                    help="Auto-planner plan file (python -m "
+                         "distributedpytorch_tpu plan): legs the plan "
+                         "ranks run first in predicted-winner order and "
+                         "their rows carry plan_rank/plan_cost_s; "
+                         "missing/stale plans degrade to the default "
+                         "order")
     args = ap.parse_args(argv)
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
 
+    plan_ranks = load_plan_ranks(args.plan)
     state = load_state(args.out)
-    todo = [(n, e, b) for n, e, b in CONFIGS
-            if state.get(n) in (None, "innocent")]
+    todo = order_by_plan(
+        [(n, e, b) for n, e, b in CONFIGS
+         if state.get(n) in (None, "innocent")],
+        plan_ranks,
+    )
     if not todo:
         print(f"bench_multi: all {len(CONFIGS)} configs terminally "
               f"resolved in {args.out}")
@@ -439,6 +514,14 @@ def main(argv=None) -> int:
     probe = _probe_once(args.probe_timeout)
     append_line(args.out, {"event": "session_start", "probe": probe,
                            "todo": [n for n, _, _ in todo],
+                           "plan": (
+                               {"path": args.plan,
+                                "legs": {n: d["plan_rank"]
+                                         for n, d in plan_ranks.items()}}
+                               if plan_ranks else
+                               {"path": args.plan, "legs": {}}
+                               if args.plan else None
+                           ),
                            "supervisor_restarts": supervisor_restarts()})
     if not probe.get("ok"):
         print(f"bench_multi: runtime dead at start: {probe}")
@@ -463,12 +546,13 @@ def main(argv=None) -> int:
     # The flight dump path IS process state — restore it on every exit
     # so an embedding process (tests, a watcher) keeps its own routing.
     try:
-        return _run_configs(args, todo, bench, _probe_once)
+        return _run_configs(args, todo, bench, _probe_once, plan_ranks)
     finally:
         flight.set_dump_path(None)
 
 
-def _run_configs(args, todo, bench, _probe_once) -> int:
+def _run_configs(args, todo, bench, _probe_once, plan_ranks=None) -> int:
+    plan_ranks = plan_ranks or {}
     for name, env, budget in todo:
         # static preflight BEFORE the attempting marker and the watchdog:
         # a poison-marked config consumes none of the session budget
@@ -478,7 +562,8 @@ def _run_configs(args, todo, bench, _probe_once) -> int:
         # aborts inside the bench, excepthook) to its own artifact
         flight.set_dump_path(flight_artifact_path(args.out, name))
         append_line(args.out, {"event": "attempting", "config": name,
-                               "budget_s": budget})
+                               "budget_s": budget,
+                               **_plan_provenance(plan_ranks, name)})
         dog = _arm_config_watchdog(args.out, name, budget)
         try:
             result = _run_one(bench, name, env, budget)
@@ -553,6 +638,7 @@ def _run_configs(args, todo, bench, _probe_once) -> int:
         append_line(args.out, {
             "config": name, **result,
             "flight_recorder": flight_artifact_path(args.out, name),
+            **_plan_provenance(plan_ranks, name),
         })
         print(json.dumps({"config": name, **result}))
         sys.stdout.flush()

@@ -24,6 +24,23 @@ OUT="${1:-.perf_r05}"
 mkdir -p "$OUT"
 OUT="$(cd "$OUT" && pwd)"
 
+# Auto-planner plan (docs/PERFORMANCE.md "Planning"): rank the window's
+# legs by predicted win BEFORE touching the chip. The planner runs on a
+# self-provisioned CPU mesh (zero chip involvement — safe even while
+# holding the window) and is budget-bounded; bench_multi --plan then
+# runs predicted winners first and degrades to its hand order if the
+# plan is missing/stale. Generated once per outdir; delete plan.json to
+# re-plan with a different grid.
+PLAN="$OUT/plan.json"
+if [ ! -f "$PLAN" ]; then
+    echo "== generating auto-planner plan (CPU-only)"
+    timeout --signal=TERM 1800 \
+        python -m distributedpytorch_tpu plan --out "$PLAN" \
+        --strategies singleGPU MP --remat off --dtypes bf16 \
+        --budget-s 1200 \
+        || echo "plan generation failed — bench_multi will use its default order"
+fi
+
 echo "== pre-flight health probe"
 if ! python tools/tpu_health.py --timeout 300 --out "$OUT/health_pre3.json"; then
     echo "runtime unhealthy — aborting (see $OUT/health_pre3.json)"
@@ -47,7 +64,8 @@ for attempt in 1 2 3 4 5 6; do
     # (the exact failure ADVICE r05 flagged when this was 11000s
     # against the same 13800s sum).
     timeout --signal=TERM 16800 \
-        python -u tools/bench_multi.py --out "$OUT/bench_multi.jsonl"
+        python -u tools/bench_multi.py --out "$OUT/bench_multi.jsonl" \
+        --plan "$PLAN"
     RC=$?
     case $RC in
         0) echo "all configs terminally resolved"; break ;;
