@@ -158,6 +158,42 @@ def gspmd_comms_program(strategy: str, param_storage_bytes: int,
     return []
 
 
+#: HBM round-trips over the (B·H·W) f32 activation/probability plane
+#: that each engaged Pallas kernel FUSES AWAY, per step (the ``kernels``
+#: search axis, ops/kernels.py). Order-of-magnitude accounting, like
+#: every number here — the model ranks kernel-on vs kernel-off, it does
+#: not measure:
+#:
+#: * ``fused_loss``    — XLA schedules the four loss-stat reductions as
+#:   separate fusions over the prob map (forward) plus an elementwise
+#:   backward read; the one-pass kernel + analytic VJP reads it once
+#:   each way: ~4 plane passes saved.
+#: * ``conv_epilogue`` — each DoubleConv BN-normalize + ReLU is two
+#:   read+write passes over the conv output, twice per block, folded to
+#:   one multiply-add pass (+ the backward's fused dz/dx): ~4 passes of
+#:   plane-scale activation traffic saved per step.
+#: * ``eval_stats`` / ``serve_mask`` — not on the train step; listed for
+#:   completeness (serve_mask's win is D2H bytes, not step HBM).
+KERNEL_SAVED_PASSES: Dict[str, float] = {
+    "fused_loss": 4.0,
+    "conv_epilogue": 4.0,
+    "eval_stats": 0.0,
+    "serve_mask": 0.0,
+}
+
+
+def kernel_savings_s(kernels: Iterable[str], plane_bytes: int,
+                     mesh: MeshModel) -> float:
+    """Predicted seconds a ``--kernels pallas`` point saves off its XLA
+    twin's step: saved HBM passes × the f32 activation-plane bytes /
+    HBM bandwidth. Monotone in what it abstracts (fused traffic), never
+    quoted as a measurement."""
+    passes = sum(KERNEL_SAVED_PASSES.get(k, 0.0) for k in kernels)
+    if passes <= 0 or plane_bytes <= 0:
+        return 0.0
+    return passes * float(plane_bytes) / mesh.hbm_bytes_per_s
+
+
 #: The memory-pressure factor saturates here: occupancy beyond ~99% of
 #: the budget is the infeasibility cliff, not a finer gradation.
 MAX_HBM_PRESSURE = 100.0

@@ -47,10 +47,13 @@ with rationale and what each provably excludes: docs/ANALYSIS.md):
   ``jnp.float32``/``np.float32`` literal (or ``astype("float32")``)
   inside a traced function is an upcast the ``--dtype`` policies cannot
   see — under bf16 it silently re-widens a hot-path tensor, under
-  bf16_params it forks the param dtype mid-trace. Sanctioned seams spell
-  the contract by NAME (``precision.LOSS_DTYPE`` / ``WGRAD_DTYPE`` /
-  ``REDUCE_DTYPE``) or live in the sanctioned modules (the loss/kernel
-  families whose f32 accumulation IS the policy).
+  bf16_params it forks the param dtype mid-trace. The rule reaches
+  Pallas KERNEL BODIES (functions handed to ``pallas_call``) and
+  custom-VJP forward/backward bodies (``defvjp``): kernel accumulators
+  must spell the contract by NAME (``precision.LOSS_DTYPE`` /
+  ``WGRAD_DTYPE`` / ``REDUCE_DTYPE`` / ``NORM_DTYPE``) — the kernel
+  modules comply and are no longer blanket-exempt; only the loss/quant/
+  structured-conv modules whose f32 IS the policy remain sanctioned.
 
 * ``ckpt-dtype-drift`` — donation-aware save/restore dtype drift: a
   ``load_checkpoint``/``load_weights`` call whose enclosing function
@@ -87,11 +90,16 @@ from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
 from distributedpytorch_tpu.analysis import Finding
 
 #: Call names whose function-valued arguments get traced by jax.
+#: ``pallas_call`` makes Pallas KERNEL BODIES traced scopes (a bare f32
+#: accumulator inside one is exactly the drift the dtype-policy rule
+#: exists for); ``defvjp`` reaches hand-written custom-VJP forward and
+#: backward bodies the same way.
 TRACE_ENTRYPOINTS = frozenset({
     "jit", "pmap", "vmap", "grad", "value_and_grad", "vjp", "jvp",
     "checkpoint", "remat", "cond", "switch", "scan", "while_loop",
     "shard_map", "eval_shape", "make_jaxpr", "custom_vjp", "custom_jvp",
-    "fori_loop", "associative_scan", "named_call",
+    "fori_loop", "associative_scan", "named_call", "pallas_call",
+    "defvjp",
 })
 
 #: Decorators that make the decorated function traced.
@@ -108,6 +116,7 @@ CALLABLE_ARG_POSITIONS = {
     "switch": (1,),       # switch(index, branches, *operands)
     "while_loop": (0, 1),
     "fori_loop": (2,),
+    "defvjp": (0, 1),     # f.defvjp(fwd, bwd) — both bodies trace
 }
 #: Keyword names that carry callables into trace entrypoints.
 CALLABLE_KEYWORDS = frozenset({"f", "fun", "fn", "body", "body_fun",
@@ -176,15 +185,16 @@ F32_LITERAL_DOTTED = frozenset({
 })
 #: Modules whose f32 literals ARE the policy: the precision module
 #: itself, the loss family (f32 loss/stats is the LOSS_DTYPE contract's
-#: implementation), and the hand-written kernels whose f32 VMEM
-#: accumulators are load-bearing numerics, not policy drift.
+#: implementation), and the structured-conv rewrites. The Pallas kernel
+#: modules (ops/{pallas_kernels,wgrad_pallas,fused_loss,kernels}.py)
+#: are deliberately NOT here: the rule reaches kernel bodies (via the
+#: ``pallas_call``/``defvjp`` entrypoints above) and their accumulators
+#: spell the named contract constants (LOSS_DTYPE/WGRAD_DTYPE/
+#: NORM_DTYPE) — a bare f32 there is drift, not policy.
 DTYPE_POLICY_SANCTIONED_MODULES = (
     os.path.join("ops", "precision.py"),
     os.path.join("ops", "losses.py"),
-    os.path.join("ops", "fused_loss.py"),
     os.path.join("ops", "quant.py"),
-    os.path.join("ops", "pallas_kernels.py"),
-    os.path.join("ops", "wgrad_pallas.py"),
     os.path.join("ops", "conv_backward.py"),
     os.path.join("ops", "s2d.py"),
 )

@@ -47,7 +47,7 @@ import math
 import os
 import sys
 import time
-from typing import Dict, List, Mapping, Optional, Sequence
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from distributedpytorch_tpu.analysis import (
     ANALYSIS_STRATEGIES,
@@ -79,6 +79,13 @@ DEFAULT_GRID: Dict[str, tuple] = {
     "remats": (False, True),
     "batches": (4, 8),
     "dtypes": ("bf16", "bf16_params"),
+    # The Pallas kernel-engagement axis (ops/kernels.py) is OFF by
+    # default: kernel-on points cost no extra compile (they derive from
+    # their XLA twin + the analytic fused-traffic saving), but ranking
+    # them is only meaningful against a per-chip Mosaic probe priors
+    # file — the CLI widens this to ("xla", "pallas") when
+    # --kernel-priors (or explicit --kernels) is passed.
+    "kernels": ("xla",),
 }
 
 EXIT_CLEAN = 0
@@ -96,13 +103,18 @@ class PlanPoint:
     remat: bool
     batch: int
     dtype: str
+    # Kernel-engagement policy (ops/kernels.py): "xla" keeps the key
+    # format (and every pre-existing plan row) unchanged; "pallas"
+    # points derive from their xla twin + the analytic kernel saving.
+    kernels: str = "xla"
 
     @property
     def key(self) -> str:
         sched = f"/{self.schedule}/m{self.microbatches}" if self.schedule else ""
         remat = "on" if self.remat else "off"
+        kern = f"/k-{self.kernels}" if self.kernels != "xla" else ""
         return (f"{self.strategy}{sched}/s2d{self.s2d_levels}"
-                f"/remat-{remat}/b{self.batch}/{self.dtype}")
+                f"/remat-{remat}/b{self.batch}/{self.dtype}{kern}")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -118,13 +130,18 @@ def enumerate_points(
     remats: Sequence[bool],
     batches: Sequence[int],
     dtypes: Sequence[str],
+    kernels: Sequence[str] = ("xla",),
 ) -> List[PlanPoint]:
     """The cartesian grid with non-applicable axes collapsed. dtype is
-    the innermost axis so a budget-truncated run still covers both
-    policies of the earliest points (the comparison each pair exists
-    for) before opening new strategy corners."""
+    a late axis so a budget-truncated run still covers both policies of
+    the earliest points (the comparison each pair exists for) before
+    opening new strategy corners; kernels is INNERMOST — a kernel-on
+    point always directly follows the xla twin it derives from (zero
+    extra compile, so the pairing is free even under a budget)."""
     points: List[PlanPoint] = []
     seen = set()
+    # xla twins must precede their pallas derivations in the walk
+    kerns = sorted({str(k) for k in kernels}, key=lambda k: k != "xla")
     for strategy in strategies:
         scheds: Sequence[Optional[str]] = (
             tuple(schedules) if strategy in PIPELINE_STRATEGIES else (None,)
@@ -132,11 +149,11 @@ def enumerate_points(
         mbs: Sequence[Optional[int]] = (
             tuple(microbatches) if strategy in PIPELINE_STRATEGIES else (None,)
         )
-        for sched, m, b, s2d, remat, dt in itertools.product(
-            scheds, mbs, batches, s2d_levels, remats, dtypes
+        for sched, m, b, s2d, remat, dt, kern in itertools.product(
+            scheds, mbs, batches, s2d_levels, remats, dtypes, kerns
         ):
             p = PlanPoint(strategy, sched, m, int(s2d), bool(remat),
-                          int(b), dt)
+                          int(b), dt, kern)
             if p not in seen:
                 seen.add(p)
                 points.append(p)
@@ -327,6 +344,74 @@ def evaluate_point(point: PlanPoint, image_size, widths,
     return row
 
 
+def _engaged_train_kernels(point: PlanPoint, widths) -> Tuple[str, ...]:
+    """Probe-registry names a TRAIN step at this point would engage
+    under a pallas kernel policy (ops/kernels.train_step_kernels over
+    the point's config — the one definition of engagement)."""
+    from distributedpytorch_tpu.ops.kernels import train_step_kernels
+
+    return train_step_kernels(_point_config(point, (64, 64), widths))
+
+
+def _kernel_point_row(
+    point: PlanPoint,
+    twin_row: Optional[dict],
+    mesh_model: cm.MeshModel,
+    priors: Optional[dict],
+    image_size,
+    widths,
+) -> dict:
+    """A ``kernels='pallas'`` point's row, derived with ZERO compile and
+    ZERO device time:
+
+    * any engaged kernel the Mosaic probe priors mark rejected → the
+      point is infeasible, carrying the probe's reject reason verbatim;
+    * otherwise the row copies its xla twin's compiled artifacts (the
+      interpret-mode Pallas compile on the planning CPU would distort
+      flops/liveness, the twin's are the honest hardware-shaped numbers)
+      and subtracts the analytic fused-traffic saving
+      (cost_model.kernel_savings_s) from the predicted cost.
+    """
+    row = point.as_dict()
+    engaged = _engaged_train_kernels(point, widths)
+    prior_rows = (priors or {}).get("kernels", {})
+    for name in engaged:
+        verdict = prior_rows.get(name)
+        if isinstance(verdict, dict) and not verdict.get("accepted", True):
+            reason = verdict.get("reason", "no reason recorded")
+            row.update(
+                feasible=False,
+                reject=f"kernels: Mosaic rejected {name}: {reason}",
+                predicted=None,
+            )
+            return row
+    if twin_row is None or twin_row.get("skipped"):
+        row.update(feasible=None, reject=None, predicted=None,
+                   skipped="budget")
+        return row
+    if not twin_row.get("feasible"):
+        row.update(feasible=False, reject=twin_row.get("reject"),
+                   predicted=None)
+        return row
+    predicted = dict(twin_row.get("predicted") or {})
+    width, height = image_size  # (W, H), the reference convention
+    plane_bytes = point.batch * height * width * 4
+    saving = cm.kernel_savings_s(engaged, plane_bytes, mesh_model)
+    cost = predicted.get("cost_s")
+    if cost:
+        new_cost = max(cost - saving, 0.05 * cost)
+        predicted["cost_s"] = new_cost
+        predicted["imgs_per_s"] = round(point.batch / new_cost, 2)
+    predicted["kernel_saving_s"] = saving
+    predicted["kernels_model"] = "analytic"
+    predicted["kernels_engaged"] = list(engaged)
+    predicted["kernel_priors"] = (
+        "accepted" if all(k in prior_rows for k in engaged) else "unprobed"
+    )
+    row.update(feasible=True, reject=None, predicted=predicted)
+    return row
+
+
 def _static_findings(points: Sequence[PlanPoint]) -> Dict[str, List[str]]:
     """One collective-checker run per distinct (strategy, schedule)
     among the points — the dual-rank re-trace included, so a
@@ -366,6 +451,8 @@ def plan(
     remats: Sequence[bool] = DEFAULT_GRID["remats"],
     batches: Sequence[int] = DEFAULT_GRID["batches"],
     dtypes: Sequence[str] = DEFAULT_GRID["dtypes"],
+    kernels: Sequence[str] = DEFAULT_GRID["kernels"],
+    kernel_priors: Optional[dict] = None,
     image_size=(960, 640),
     widths: Optional[Sequence[int]] = None,
     hbm_gb: float = 16.0,
@@ -376,17 +463,28 @@ def plan(
     """Search, reject, rank; returns the plan payload (what
     ``save_plan`` writes). ``budget_s`` > 0 stops opening new compiles
     near the wall budget — already-evaluated points keep their rows and
-    the rest carry an explicit ``skipped: budget`` marker."""
+    the rest carry an explicit ``skipped: budget`` marker.
+
+    ``kernels`` is the Pallas engagement axis (ops/kernels.py):
+    kernel-on points cost NO compile and NO device time — each derives
+    from its xla twin plus the analytic fused-traffic saving, and
+    ``kernel_priors`` (a loaded probe-priors payload) rejects any point
+    whose engaged kernel Mosaic refused, carrying the probe's reason."""
     t_start = time.monotonic()
     mm = MESH_MODELS_LOOKUP(mesh_model)
     hbm_budget_bytes = int(hbm_gb * 2**30)
+    kernels = tuple(kernels)
+    if any(k != "xla" for k in kernels) and "xla" not in kernels:
+        # every pallas point derives from its xla twin — force the pair
+        kernels = ("xla",) + kernels
     points = enumerate_points(
         strategies, schedules, microbatches, s2d_levels, remats, batches,
-        dtypes,
+        dtypes, kernels,
     )
     static = _static_findings(points)
 
     rows: List[dict] = []
+    twin_rows: Dict[PlanPoint, dict] = {}
     for point in points:
         combo = (f"{point.strategy}/{point.schedule}" if point.schedule
                  else point.strategy)
@@ -395,6 +493,12 @@ def plan(
             row = point.as_dict()
             row.update(feasible=False, reject=f"static: {lines[0]}",
                        predicted=None)
+        elif point.kernels != "xla":
+            # zero-compile derivation (and the Mosaic-priors gate)
+            twin = twin_rows.get(dataclasses.replace(point, kernels="xla"))
+            row = _kernel_point_row(
+                point, twin, mm, kernel_priors, image_size, widths
+            )
         elif budget_s and time.monotonic() - t_start > 0.8 * budget_s:
             row = point.as_dict()
             row.update(feasible=None, reject=None, predicted=None,
@@ -416,6 +520,8 @@ def plan(
                     reject=f"config: {type(exc).__name__}: {exc}",
                     predicted=None,
                 )
+        if point.kernels == "xla":
+            twin_rows[point] = row
         rows.append(row)
         if emit is not None:
             emit(row)
@@ -451,7 +557,23 @@ def plan(
             "remats": [bool(r) for r in remats],
             "batches": list(batches),
             "dtypes": list(dtypes),
+            "kernels": list(kernels),
         },
+        "kernel_priors": (
+            {
+                "platform": kernel_priors.get("platform"),
+                "device_kind": kernel_priors.get("device_kind"),
+                "rejected": sorted(
+                    name
+                    for name, row in (
+                        kernel_priors.get("kernels") or {}
+                    ).items()
+                    if isinstance(row, dict) and not row.get("accepted", True)
+                ),
+            }
+            if kernel_priors
+            else None
+        ),
         "static_findings": static,
         "points": rows,
         "ranking": [r["key"] for r in ranked],
@@ -506,8 +628,13 @@ def load_plan(path: str) -> Optional[dict]:
 #: move a wedge-suspect compile to the front of a chip window.
 _MODELED_LEVERS = frozenset(
     {"BENCH_S2D_LEVELS", "BENCH_BATCH", "BENCH_ARCH",
-     "BENCH_PIPELINE_SWEEP"}
+     "BENCH_PIPELINE_SWEEP", "BENCH_PALLAS_LOSS", "BENCH_KERNEL_SWEEP"}
 )
+
+#: Point fields a selector may constrain that old plan files (written
+#: before the axis existed) don't carry: a missing field reads as its
+#: historical value, so pre-kernels plans keep ranking the same legs.
+_SELECTOR_DEFAULTS = {"kernels": "xla"}
 
 
 def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
@@ -522,7 +649,7 @@ def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
         # a best-case proxy (where do MP configs land at all), so only
         # the strategy is constrained
         return {"strategy": "MP"}
-    return {
+    selector = {
         "strategy": "singleGPU",
         "batch": int(env.get("BENCH_BATCH", "4")),
         # bench.py's s2d auto resolves to 2 on the TPU backend
@@ -531,7 +658,21 @@ def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
         # bench.py hardcodes bf16 compute (no BENCH_DTYPE lever): a
         # bf16_params point's rank must not stamp a leg that runs bf16
         "dtype": "bf16",
+        # ...and the same logic for kernels: a pallas-kernels point's
+        # rank must not stamp a leg that runs the xla paths
+        "kernels": "pallas" if env.get("BENCH_PALLAS_LOSS") == "1" else "xla",
     }
+    if env.get("BENCH_KERNEL_SWEEP") == "1":
+        # The sweep's predicted win is its PALLAS cells, and requiring a
+        # pallas point is also the ordering safety: a plan only carries
+        # ranked pallas points when it was generated against a Mosaic
+        # priors file (--kernel-priors), i.e. the probe already ran and
+        # its file exists for the sweep's own rejected-cell skips. On a
+        # priors-less window no pallas point exists, the sweep stays
+        # unranked, and the hand order keeps it BEHIND kernel_probe —
+        # prediction never moves a Mosaic-unvetted compile earlier.
+        selector["kernels"] = "pallas"
+    return selector
 
 
 def rank_legs(payload: dict, configs) -> Dict[str, dict]:
@@ -553,9 +694,20 @@ def rank_legs(payload: dict, configs) -> Dict[str, dict]:
         selector = _leg_selector(env)
         if selector is None:
             continue
+        if selector.get("kernels") == "pallas" and not payload.get(
+            "kernel_priors"
+        ):
+            # defense in depth for the probe-first ordering invariant:
+            # even a hand-built plan carrying ranked pallas points must
+            # not promote a Pallas-compiling leg unless the plan records
+            # that it was generated against a Mosaic priors file
+            continue
         matches = [
             p for p in ranked_points
-            if all(p.get(k) == v for k, v in selector.items())
+            if all(
+                p.get(k, _SELECTOR_DEFAULTS.get(k)) == v
+                for k, v in selector.items()
+            )
         ]
         if not matches:
             continue
@@ -596,6 +748,20 @@ def build_parser() -> argparse.ArgumentParser:
                     default=list(g["batches"]))
     ap.add_argument("--dtypes", nargs="+", default=list(g["dtypes"]),
                     choices=["f32", "bf16", "bf16_params"])
+    ap.add_argument("--kernels", nargs="+", default=None,
+                    choices=["xla", "pallas"],
+                    help="Pallas kernel-engagement axis (ops/kernels.py). "
+                         "Default: xla only; widens to both when "
+                         "--kernel-priors is given (kernel-on points cost "
+                         "zero extra compile — they derive from their xla "
+                         "twin + the analytic fused-traffic saving)")
+    ap.add_argument("--kernel-priors", default=None,
+                    help="Per-chip Mosaic probe priors file "
+                         "(tools/probe_kernels.py): kernel-on points whose "
+                         "engaged kernel the chip's compiler rejected are "
+                         "rejected here too, with the probe's reason, at "
+                         "zero device time; missing/stale/corrupt files "
+                         "are ignored with a note (kernels rank unprobed)")
     ap.add_argument("--image-size", type=int, nargs=2, default=(960, 640),
                     metavar=("W", "H"),
                     help="Target geometry (the reference 960 640)")
@@ -624,6 +790,27 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         return EXIT_INFRA
     hbm_gb = args.hbm_gb if args.hbm_gb is not None else mm.hbm_gb
 
+    priors = None
+    if args.kernel_priors:
+        from distributedpytorch_tpu.ops.kernels import load_priors
+
+        priors = load_priors(args.kernel_priors)
+        if priors is None:
+            print(f"plan: kernel priors {args.kernel_priors!r} missing, "
+                  f"stale, or corrupt — ignored; kernel points rank "
+                  f"unprobed", file=sys.stderr)
+    if args.kernels is not None:
+        kernels = tuple(args.kernels)
+    elif priors is not None:
+        # a LOADED priors file is the opt-in: search kernel-on vs
+        # kernel-off. A --kernel-priors path whose file is missing/stale
+        # must NOT widen the axis — an unprobed pallas point would rank,
+        # and bench_multi --plan would promote the kernel legs ahead of
+        # the probe leg that vets them.
+        kernels = ("xla", "pallas")
+    else:
+        kernels = DEFAULT_GRID["kernels"]
+
     def emit(row):
         line = {k: row.get(k) for k in ("key", "feasible", "reject")}
         if row.get("skipped"):
@@ -642,6 +829,8 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
             remats=remats,
             batches=args.batches,
             dtypes=args.dtypes,
+            kernels=kernels,
+            kernel_priors=priors,
             image_size=tuple(args.image_size),
             widths=tuple(args.widths) if args.widths else None,
             hbm_gb=hbm_gb,

@@ -109,8 +109,22 @@ def get_args():
     parser.add_argument("--remat", action="store_true",
                         help="Rematerialize activations in the backward "
                              "(~half HBM, ~1/3 more FLOPs)")
+    parser.add_argument("--kernels", type=str, default="xla",
+                        choices=["xla", "pallas"],
+                        help="Pallas kernel-engagement policy "
+                             "(ops/kernels.py): xla = no fast paths "
+                             "(bit-identical reference, default); pallas "
+                             "= fused loss stats, one-pass eval stats, "
+                             "the DoubleConv BN+ReLU epilogue, and the "
+                             "serve mask kernel — each revocable by the "
+                             "Mosaic probe priors")
+    parser.add_argument("--kernel-priors", type=str, default=None,
+                        help="Per-chip Mosaic probe priors file "
+                             "(tools/probe_kernels.py): kernels the "
+                             "chip's compiler rejected disengage loudly")
     parser.add_argument("--pallas", action="store_true",
-                        help="Use the fused Pallas loss-stats kernel for eval")
+                        help="LEGACY alias for the fused loss/eval-stats "
+                             "kernels only — prefer --kernels pallas")
     parser.add_argument("--dtype", type=str, default="bf16",
                         choices=["f32", "bf16", "bf16_params"],
                         help="Mixed-precision policy (ops/precision.py): "
@@ -289,6 +303,8 @@ def main():
         grad_accum=args.grad_accum,
         remat=args.remat,
         use_pallas=args.pallas,
+        kernels=args.kernels,
+        kernel_priors=args.kernel_priors,
         model_arch=args.model_arch,
         model_widths=tuple(args.model_widths) if args.model_widths else None,
         dtype=args.dtype,

@@ -221,13 +221,27 @@ class TrainConfig:
     # the reference config); turn on for big batches / high resolutions.
     remat: bool = False
 
-    # -- kernels ------------------------------------------------------------
-    # Route the eval loss+Dice through the fused one-pass Pallas stats
-    # kernel (ops/pallas_kernels.py). Same formulas as the XLA path, equal
-    # within summation-order tolerance (~1e-5 relative); takes effect only
-    # on strategies whose eval batch is unsharded (singleGPU — pallas_call
-    # has no GSPMD partition rule); sharded strategies warn and keep the
-    # XLA path. Off by default.
+    # -- kernels (ops/kernels.py, docs/PERFORMANCE.md "Kernels") ------------
+    # The Pallas kernel-engagement policy, --kernels:
+    #   "xla"     no Pallas fast paths — every output bit-identical to
+    #             the historical paths (the correctness reference);
+    #   "pallas"  the full kernel tier: fused training-loss stats
+    #             (ops/fused_loss.py), one-pass eval stats
+    #             (ops/pallas_kernels.py), the fused DoubleConv
+    #             BN+ReLU epilogue (milesial), and the serve tier's
+    #             sigmoid/threshold mask kernel — each individually
+    #             revoked by a per-chip Mosaic probe priors file
+    #             (kernel_priors / DPT_KERNEL_PRIORS) that marks it
+    #             rejected, falling back bit-identically to XLA.
+    kernels: str = "xla"
+    # Per-chip Mosaic probe priors file (tools/probe_kernels.py →
+    # ops/kernels.load_priors): kernels the chip's compiler rejected
+    # disengage loudly. None = also honors $DPT_KERNEL_PRIORS.
+    kernel_priors: Optional[str] = None
+    # LEGACY alias (pre-policy flag, kept like compute_dtype → --dtype):
+    # True resolves to its historical engagement set — the fused
+    # training loss + eval stats kernels only — with a loud log. An
+    # explicit kernels="pallas" supersedes it. Prefer --kernels.
     use_pallas: bool = False
 
     # -- dispatch amortization ----------------------------------------------
@@ -279,6 +293,18 @@ class TrainConfig:
         return get_policy(self)
 
     @property
+    def kernel_policy(self):
+        """Convenience accessor for the resolved
+        :class:`~distributedpytorch_tpu.ops.kernels.KernelPolicy` — the
+        resolver is ``ops.kernels.get_kernel_policy(config)`` (honoring
+        the legacy ``use_pallas`` alias and the Mosaic probe priors);
+        this property wraps the same call, so there is exactly one
+        resolution path (the precision property's pattern)."""
+        from distributedpytorch_tpu.ops.kernels import get_kernel_policy
+
+        return get_kernel_policy(self)
+
+    @property
     def val_fraction(self) -> float:
         return self.val_percent / 100.0
 
@@ -317,6 +343,15 @@ class ServeConfig:
     #            source hash in its manifest). Dice parity vs the float
     #            checkpoint is pinned by tests/test_quantize.py.
     quantize: Optional[str] = None
+    # Kernel-engagement policy for the serving path (--kernels,
+    # ops/kernels.py): "pallas" traces the fused sigmoid/threshold mask
+    # kernel INSIDE every AOT bucket executable — the executable returns
+    # the {0,255} uint8 mask itself (1 byte/pixel D2H instead of 4 f32,
+    # no host threshold pass), bit-identical to the "xla" path's
+    # postprocess at the operating threshold. Honors the Mosaic probe
+    # priors exactly like training.
+    kernels: str = "xla"
+    kernel_priors: Optional[str] = None
 
     # -- batching -----------------------------------------------------------
     # The padded bucket ladder: every dispatch rides one of exactly these

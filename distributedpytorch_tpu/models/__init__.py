@@ -9,7 +9,10 @@ def create_model(config):
     models (milesial's BatchNorm) return their non-trainable collections as
     the second element. The model's compute dtype comes from the resolved
     precision policy (config.precision — ops/precision.py), so ``--dtype``
-    and the legacy ``compute_dtype`` override resolve in exactly one place.
+    and the legacy ``compute_dtype`` override resolve in exactly one place;
+    the kernel policy's conv-epilogue engagement resolves through
+    ``ops.kernels.conv_epilogue_engaged`` the same way (``--kernels``,
+    Mosaic probe priors, and the device-local-forward gate in one place).
     """
     from distributedpytorch_tpu.ops.precision import get_policy
 
@@ -30,12 +33,15 @@ def create_model(config):
             init_milesial,
         )
 
+        from distributedpytorch_tpu.ops.kernels import conv_epilogue_engaged
+
         widths = tuple(config.model_widths) if config.model_widths else MILESIAL_WIDTHS
         model = MilesialUNet(
             widths=widths,
             dtype=compute_dtype,
             s2d_levels=getattr(config, "s2d_levels", -1),
             wgrad_taps=getattr(config, "wgrad_taps", False),
+            conv_epilogue=conv_epilogue_engaged(config),
         )
 
         def init_fn(rng, input_hw):

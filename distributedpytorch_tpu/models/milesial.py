@@ -135,6 +135,7 @@ class _DownS2D(nn.Module):
     this_s2d: bool  # this level's DoubleConv runs in the s2d domain
     dtype: Any = jnp.bfloat16
     wgrad_taps: bool = False
+    epilogue: bool = False  # pixel-domain DoubleConv only (the boundary)
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -151,7 +152,7 @@ class _DownS2D(nn.Module):
             )(x, train)
         return DoubleConv(
             self.features, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-            name="conv",
+            epilogue=self.epilogue, name="conv",
         )(x, train)
 
 
@@ -193,14 +194,72 @@ class _UpS2D(nn.Module):
         )(x, train)
 
 
+class _FusedEpilogueBatchNorm(nn.Module):
+    """``nn.BatchNorm`` + ReLU with the normalize+activation tail in ONE
+    fused VMEM pass (ops/kernels.fused_bn_act — the ``--kernels pallas``
+    conv-epilogue engagement site). Parameter and ``batch_stats`` trees
+    are EXACTLY ``nn.BatchNorm``'s (scale/bias params, mean/var stats —
+    same names, shapes, inits), so checkpoints are interchangeable with
+    the XLA path. The batch statistics themselves (mean/var reductions +
+    running-average updates, mirroring flax's fast-variance formula) stay
+    XLA: they are reductions the compiler already fuses, and keeping them
+    outside lets autodiff chain d(mean)/d(var) → x through the kernel's
+    hand-written VJP. Matches the XLA twin to float-rounding tolerance
+    (the folded affine associates differently — tests/test_kernels.py)."""
+
+    features: int
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
+        from distributedpytorch_tpu.ops.kernels import fused_bn_act
+
+        C = self.features
+        scale = self.param(
+            "scale", nn.initializers.ones_init(), (C,), jnp.float32
+        )
+        bias = self.param(
+            "bias", nn.initializers.zeros_init(), (C,), jnp.float32
+        )
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((C,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((C,), jnp.float32)
+        )
+        xf = x.astype(jnp.float32)
+        if train:
+            # flax _compute_stats fast-variance: E[x²] − E[x]², clipped
+            mean = jnp.mean(xf, axis=(0, 1, 2))
+            mean2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+            var = jnp.maximum(0.0, mean2 - jnp.square(mean))
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1.0 - self.momentum) * var
+                )
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        return fused_bn_act(xf, mean, var, scale, bias, epsilon=self.epsilon)
+
+
 class DoubleConv(nn.Module):
     """[Conv3×3(no bias) → BatchNorm → ReLU] × 2
-    (reference model/modelsummary.txt:155-160)."""
+    (reference model/modelsummary.txt:155-160).
+
+    ``epilogue=True`` fuses each BN-normalize + ReLU tail into one VMEM
+    pass (``_FusedEpilogueBatchNorm``) while XLA keeps the conv itself —
+    the ``--kernels pallas`` conv-epilogue engagement; identical param
+    tree either way."""
 
     features: int
     mid_features: int = 0  # 0 = features (bilinear Up passes in//2)
     dtype: Any = jnp.bfloat16
     wgrad_taps: bool = False
+    epilogue: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
@@ -216,6 +275,11 @@ class DoubleConv(nn.Module):
                     feats, (3, 3), padding=1, use_bias=False, dtype=self.dtype,
                     name=f"conv{i + 1}",
                 )(x)
+            if self.epilogue:
+                x = _FusedEpilogueBatchNorm(
+                    feats, name=f"bn{i + 1}"
+                )(x, train).astype(self.dtype)
+                continue
             # float32 statistics; torch defaults are eps=1e-5, momentum=0.1
             # (flax momentum = 1 − torch momentum)
             x = nn.BatchNorm(
@@ -232,13 +296,14 @@ class Down(nn.Module):
     features: int
     dtype: Any = jnp.bfloat16
     wgrad_taps: bool = False
+    epilogue: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = False) -> jax.Array:
         x = nn.max_pool(x, window_shape=(2, 2), strides=(2, 2))
         return DoubleConv(
             self.features, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-            name="conv",
+            epilogue=self.epilogue, name="conv",
         )(x, train)
 
 
@@ -254,6 +319,7 @@ class Up(nn.Module):
     bilinear: bool = False
     dtype: Any = jnp.bfloat16
     wgrad_taps: bool = False
+    epilogue: bool = False
 
     @nn.compact
     def __call__(
@@ -275,7 +341,7 @@ class Up(nn.Module):
         x = jnp.concatenate([skip, x], axis=-1)
         return DoubleConv(
             self.features, mid_features=mid, dtype=self.dtype,
-            wgrad_taps=self.wgrad_taps, name="conv",
+            wgrad_taps=self.wgrad_taps, epilogue=self.epilogue, name="conv",
         )(x, train)
 
 
@@ -299,6 +365,12 @@ class MilesialUNet(nn.Module):
     dtype: Any = jnp.bfloat16
     s2d_levels: int = -1
     wgrad_taps: bool = False
+    # Fuse every pixel-domain DoubleConv's BN-normalize + ReLU into one
+    # VMEM pass (ops/kernels.fused_bn_act, --kernels pallas). Identical
+    # param/batch_stats trees; s2d-domain levels keep _S2DBatchNorm.
+    # Engagement is the model factory's call (models/__init__.py via
+    # ops/kernels.conv_epilogue_engaged — device-local forwards only).
+    conv_epilogue: bool = False
 
     # train/steps.py and parallel/pipeline.py key off this to thread the
     # batch_stats collection
@@ -407,7 +479,7 @@ class MilesialUNet(nn.Module):
                 else:
                     x = DoubleConv(
                         w[0], dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-                        name="inc",
+                        epilogue=self.conv_epilogue, name="inc",
                     )(x, train)
                 skips = skips + (x,)
             elif seg <= L:  # Down level `seg`
@@ -421,12 +493,12 @@ class MilesialUNet(nn.Module):
                         feats, in_features=w[level - 1],
                         prev_s2d=level - 1 < lv, this_s2d=level < lv,
                         dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-                        name=f"down{level}",
+                        epilogue=self.conv_epilogue, name=f"down{level}",
                     )(x, train)
                 else:
                     x = Down(
                         feats, dtype=self.dtype, wgrad_taps=self.wgrad_taps,
-                        name=f"down{level}",
+                        epilogue=self.conv_epilogue, name=f"down{level}",
                     )(x, train)
                 if level < L:  # the deepest Down is the bottleneck, no skip
                     skips = skips + (x,)
@@ -452,6 +524,7 @@ class MilesialUNet(nn.Module):
                         bilinear=self.bilinear,
                         dtype=self.dtype,
                         wgrad_taps=self.wgrad_taps,
+                        epilogue=self.conv_epilogue,
                         name=f"up{i + 1}",
                     )(x, skip, train)
                 if seg == 2 * L:

@@ -80,6 +80,11 @@ logger = logging.getLogger(__name__)
 LOSS_DTYPE = jnp.float32    # loss + Dice/BCE stats accumulation
 WGRAD_DTYPE = jnp.float32   # weight-grad accumulation (pipeline, accum, master)
 REDUCE_DTYPE = jnp.float32  # cross-device grad/stats psums
+# BatchNorm statistics + normalization math (models/milesial.py: variance
+# in bf16 is numerically unsafe, so BN computes f32 and casts back under
+# every policy). Named here so the fused conv-epilogue kernel
+# (ops/kernels.py) spells the same contract the XLA BN path implements.
+NORM_DTYPE = jnp.float32
 
 
 def _is_float_leaf(x) -> bool:

@@ -49,6 +49,12 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+# The tap accumulator spells the WGRAD_DTYPE contract (ops/precision.py):
+# weight-gradient accumulation is f32 under every --dtype policy — the
+# dptlint ``dtype-policy`` rule reaches kernel bodies, and the named
+# constant is its sanctioned spelling (this module is no longer exempt).
+from distributedpytorch_tpu.ops.precision import WGRAD_DTYPE
+
 try:  # TPU-specific memory space; absent on some CPU-only installs
     from jax.experimental.pallas import tpu as pltpu
 
@@ -81,7 +87,7 @@ def _wgrad_kernel(x0, x1, x2, d0, d1, d2, out_ref):
                 xrow,
                 dpad,
                 (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
+                preferred_element_type=WGRAD_DTYPE,
             )
 
 
@@ -129,7 +135,7 @@ def wgrad_9tap_pallas(
         grid=(b, h),
         in_specs=x_specs + d_specs,
         out_specs=out_spec,
-        out_shape=jax.ShapeDtypeStruct((3, 3, cin, cout), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((3, 3, cin, cout), WGRAD_DTYPE),
         interpret=interpret,
         **kwargs,
     )(xp, xp, xp, *dps)

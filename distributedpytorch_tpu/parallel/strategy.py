@@ -86,6 +86,14 @@ class Strategy:
         # resolved ONCE here; the steps this strategy builds, the
         # checkpoint manifest, and the restore path all read this object
         self.policy = get_policy(config)
+        # the kernel-engagement policy (ops/kernels.py, --kernels):
+        # resolved ONCE with the Mosaic probe priors applied, so every
+        # engagement decision this strategy makes — fused training loss,
+        # eval stats, grad-accum stats — reads one frozen object (the
+        # legacy use_pallas flag resolves inside, as a loud alias)
+        from distributedpytorch_tpu.ops.kernels import get_kernel_policy
+
+        self.kernels = get_kernel_policy(config)
 
     # -- process topology ---------------------------------------------------
     @property
@@ -159,14 +167,15 @@ class Strategy:
 
     # -- compiled steps -----------------------------------------------------
     def _train_loss_impl(self) -> Optional[Callable]:
-        """The fused Pallas training loss under ``--pallas`` (None = XLA
-        loss). Single-device runs use the kernel directly; mesh strategies
-        wrap it in shard_map — per-shard kernel + a 4-scalar stats psum
-        over the batch-sharding axes — so the loss and its custom-VJP
-        gradient equal the unsharded computation (ops/fused_loss.py; this
-        replaces round 3's gate-it-off-on-meshes behavior, VERDICT r03
-        next-5)."""
-        if not self.config.use_pallas:
+        """The fused Pallas training loss when the kernel policy engages
+        it (``--kernels pallas`` or the legacy ``--pallas`` alias; None =
+        XLA loss). Single-device runs use the kernel directly; mesh
+        strategies wrap it in shard_map — per-shard kernel + a 4-scalar
+        stats psum over the batch-sharding axes — so the loss and its
+        custom-VJP gradient equal the unsharded computation
+        (ops/fused_loss.py; this replaces round 3's
+        gate-it-off-on-meshes behavior, VERDICT r03 next-5)."""
+        if not self.kernels.train_loss_fused:
             return None
         from distributedpytorch_tpu.ops.fused_loss import (
             fused_bce_dice_loss,
@@ -218,7 +227,7 @@ class Strategy:
             chunks=self.config.grad_accum,
             faithful_loss_scaling=self.config.faithful_loss_scaling,
             remat=self.config.remat,
-            use_pallas=self.config.use_pallas and self.mesh is None,
+            use_pallas=self.kernels.train_loss_fused and self.mesh is None,
         )
         return jax.jit(step, donate_argnums=_state_donation(self.config))
 
@@ -272,20 +281,20 @@ class Strategy:
         return jax.jit(step)
 
     def _pallas_eval(self) -> bool:
-        """`use_pallas` EVAL applies only where the eval batch is unsharded
-        (single device / replicated): pallas_call has no GSPMD partitioning
-        rule, so a mesh-sharded (B,H,W,1) input would fail to lower or
-        force a de-shard. Sharded strategies keep the XLA eval metrics —
-        the TRAINING loss still runs the fused kernel via the shard_map
-        wrapper (`_train_loss_impl`), so only the per-epoch eval pass
-        differs."""
-        if not self.config.use_pallas:
+        """The fused EVAL stats kernel applies only where the eval batch
+        is unsharded (single device / replicated): pallas_call has no
+        GSPMD partitioning rule, so a mesh-sharded (B,H,W,1) input would
+        fail to lower or force a de-shard. Sharded strategies keep the
+        XLA eval metrics — the TRAINING loss still runs the fused kernel
+        via the shard_map wrapper (`_train_loss_impl`), so only the
+        per-epoch eval pass differs."""
+        if not self.kernels.eval_stats_fused:
             return False
         if self.mesh is not None:
             import logging
 
             logging.getLogger(__name__).info(
-                "--pallas: strategy %s trains through the fused kernel "
+                "--kernels: strategy %s trains through the fused kernel "
                 "(shard_map); eval metrics stay on the XLA path (sharded "
                 "eval batches cannot enter pallas_call)",
                 self.name,
@@ -593,7 +602,7 @@ class Pipeline(Strategy):
             data_axis=self.data_axis,
             remat=self.config.remat,
             cuts=self.config.pipeline_cuts,
-            use_pallas=self.config.use_pallas,
+            use_pallas=self.kernels.train_loss_fused,
             schedule=self.config.pipeline_schedule,
         )
         # per-process batch, same rationale as Strategy._raw_step

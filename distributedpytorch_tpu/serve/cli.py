@@ -64,6 +64,20 @@ def get_args(argv=None):
                              "tools/quantize.py file or quantizes a "
                              "regular checkpoint on load")
     parser.add_argument("--threshold", "-t", type=float, default=0.5)
+    parser.add_argument("--kernels", type=str, default="xla",
+                        choices=["xla", "pallas"],
+                        help="Kernel-engagement policy (ops/kernels.py): "
+                             "pallas traces the fused sigmoid/threshold "
+                             "mask kernel into every AOT bucket "
+                             "executable — uint8 masks come back from "
+                             "the device (1 byte/pixel D2H, no host "
+                             "threshold pass), bit-identical at the "
+                             "operating threshold; honors the Mosaic "
+                             "probe priors ($DPT_KERNEL_PRIORS)")
+    parser.add_argument("--kernel-priors", type=str, default=None,
+                        help="Per-chip Mosaic probe priors file "
+                             "(tools/probe_kernels.py): kernels the "
+                             "chip's compiler rejected disengage loudly")
     parser.add_argument("--buckets", type=int, nargs="+", default=(1, 2, 4, 8),
                         help="Padded batch bucket ladder — one AOT compile "
                              "per bucket per replica at startup")
@@ -110,6 +124,8 @@ def to_config(args):
         s2d_levels=args.s2d_levels,
         quantize=args.quantize,
         threshold=args.threshold,
+        kernels=args.kernels,
+        kernel_priors=args.kernel_priors,
         bucket_sizes=tuple(args.buckets),
         slo_ms=args.slo_ms,
         eager_when_idle=not args.no_eager,

@@ -76,8 +76,11 @@ class ServeEngine:
         host_cache_mb: int = 0,
         channels: int = 3,
         quantized: bool = False,
+        kernels="xla",
     ):
         import jax
+
+        from distributedpytorch_tpu.ops.kernels import get_kernel_policy
 
         self.planner = BucketPlanner(bucket_sizes)
         self.input_hw = (int(input_hw[0]), int(input_hw[1]))
@@ -91,7 +94,18 @@ class ServeEngine:
         # quantized tree; each replica's device-resident weights stay one
         # byte per element and the forward dequantizes in-trace
         self.quantized = bool(quantized)
-        self._fwd = make_forward(model, quantized=self.quantized)
+        # kernel policy (--kernels, ops/kernels.py): with serve_mask
+        # engaged the AOT bucket executables threshold ON DEVICE through
+        # the fused sigmoid/threshold kernel and return uint8 masks —
+        # postprocess() then passes them through untouched (bit-identical
+        # to the host threshold at the same operating point)
+        self.kernel_policy = get_kernel_policy(kernels)
+        self.mask_on_device = self.kernel_policy.serve_mask
+        self._fwd = make_forward(
+            model,
+            quantized=self.quantized,
+            mask_threshold=self.threshold if self.mask_on_device else None,
+        )
         variables = bundle_variables(model, params, model_state)
 
         devices = jax.devices()
@@ -162,7 +176,9 @@ class ServeEngine:
     def infer(self, batch: np.ndarray, replica_index: int = 0) -> np.ndarray:
         """Synchronous single-bucket inference (tests, warmup): pads to
         the smallest covering bucket, runs, returns the REAL rows'
-        probabilities as host float32 ``(n, H, W)``."""
+        probabilities as host float32 ``(n, H, W)`` — or, with the
+        serve-mask kernel engaged, the ``(n, H, W) uint8`` masks the
+        executable thresholded on device."""
         from distributedpytorch_tpu.serve.bucketing import pad_batch
 
         n = batch.shape[0]

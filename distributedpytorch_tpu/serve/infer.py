@@ -49,7 +49,9 @@ def load_image(path: str, size_wh: Sequence[int]) -> np.ndarray:
     return preprocess_image(BasicDataset.load(path), size_wh)
 
 
-def make_forward(model, quantized: bool = False) -> Callable:
+def make_forward(
+    model, quantized: bool = False, mask_threshold: Optional[float] = None
+) -> Callable:
     """The eval forward as a plain jittable ``fwd(variables, x) -> probs``:
     ``variables`` is ``{"params": ...}`` (plus ``"batch_stats"`` for
     stateful families — milesial BatchNorm — applied in eval mode),
@@ -63,7 +65,16 @@ def make_forward(model, quantized: bool = False) -> Callable:
     holds ``{"q": int8, "scale": f32}`` kernel subtrees — ops/quant.py):
     dequantization happens INSIDE the traced forward, so the executable's
     resident weight arguments stay one byte per element and the float
-    kernels exist only as temps."""
+    kernels exist only as temps.
+
+    ``mask_threshold`` (the ``--kernels pallas`` serve-mask engagement,
+    ops/kernels.py) traces the fused sigmoid/threshold mask kernel onto
+    the tail: the forward then returns the served ``{0, 255} uint8``
+    mask itself — 1 byte/pixel over the D2H drain instead of 4, and no
+    host threshold pass — bit-identical to ``postprocess_mask`` of the
+    probabilities at the same threshold (the model's sigmoid already ran
+    under the LOSS_DTYPE contract, so the kernel runs its exact-compare
+    threshold leg)."""
     stateful = bool(getattr(model, "is_stateful", False))
 
     def fwd(variables, x):
@@ -76,7 +87,14 @@ def make_forward(model, quantized: bool = False) -> Callable:
             probs = model.apply(variables, x, train=False)
         else:
             probs = model.apply(variables, x)
-        return probs[..., 0]
+        probs = probs[..., 0]
+        if mask_threshold is not None:
+            from distributedpytorch_tpu.ops.kernels import (
+                sigmoid_threshold_mask,
+            )
+
+            return sigmoid_threshold_mask(probs, mask_threshold)
+        return probs
 
     return fwd
 
@@ -92,8 +110,14 @@ def bundle_variables(model, params, model_state=None) -> dict:
 def postprocess_mask(probs: np.ndarray, threshold: float = 0.5) -> np.ndarray:
     """Probabilities → the served artifact: ``{0, 255} uint8`` masks
     (same shape in, channelless out). Works on a single ``(H, W)`` row or
-    a ``(B, H, W)`` batch."""
-    return (np.asarray(probs) >= threshold).astype(np.uint8) * 255
+    a ``(B, H, W)`` batch. A ``uint8`` input passes through untouched —
+    it IS the mask already, thresholded on-device by the serve-mask
+    kernel (``make_forward(mask_threshold=...)``), so the completion
+    drain stays one code path under either kernel policy."""
+    arr = np.asarray(probs)
+    if arr.dtype == np.uint8:
+        return arr
+    return (arr >= threshold).astype(np.uint8) * 255
 
 
 @dataclasses.dataclass

@@ -400,6 +400,7 @@ class Server:
         a single engine across server configurations); otherwise the
         checkpoint fields drive ``engine_from_checkpoint``."""
         if engine is None:
+            from distributedpytorch_tpu.ops.kernels import get_kernel_policy
             from distributedpytorch_tpu.serve.engine import (
                 engine_from_checkpoint,
             )
@@ -416,6 +417,10 @@ class Server:
                 replicas=cfg.replicas,
                 threshold=cfg.threshold,
                 host_cache_mb=cfg.host_cache_mb,
+                # resolve from the whole config so cfg.kernel_priors
+                # (and the legacy/env fallbacks) gate engagement exactly
+                # like training — the engine accepts a resolved policy
+                kernels=get_kernel_policy(cfg),
             )
         kwargs = dict(
             slo_ms=cfg.slo_ms,

@@ -102,6 +102,28 @@ CONFIGS = [
     # to check (no-combos fast path, like serve_bench). Budget covers 3
     # train-step compiles + 2 forward compiles + bounded timed steps.
     ("dtype_sweep", {"BENCH_DTYPE_SWEEP": "1"}, 900.0),
+    # Per-kernel compile-only Mosaic probes (ops/kernels.PROBES via
+    # tools/probe_kernels.py — the wgrad_pallas_probe pattern, one row
+    # per kernel): 60 s to learn accepted-or-rejected for EVERY Pallas
+    # kernel before the kernel_sweep (and any future --kernels pallas
+    # leg) spends measurement budget on a graph Mosaic refuses. Writes
+    # the per-chip priors file ($DPT_KERNEL_PRIORS, default
+    # kernel_priors.json) that ops/kernels.get_kernel_policy and
+    # `plan --kernel-priors` consume. Zero execution; a wedge poisons
+    # only this 60 s probe.
+    ("kernel_probe", {"BENCH_KERNEL_PROBE": "1"}, 60.0),
+    # Kernel-policy A/B (tools/bench_kernels.py): --kernels xla vs
+    # pallas per PHASE (train_loss / epilogue / eval_stats /
+    # serve_mask) — which phase each kernel bought back, the
+    # measurement row behind the --kernels default and the ≥50 imgs/s
+    # chase. Hand-ordered AFTER kernel_probe so Mosaic-rejected cells
+    # skip instead of re-compiling a refused graph — and --plan can
+    # only move it earlier when the plan carries ranked pallas points,
+    # which requires the plan to have been generated against an
+    # EXISTING priors file (planner._leg_selector), so the skip data is
+    # there either way. Single-device, collective-free → the static
+    # preflight's no-combos fast path.
+    ("kernel_sweep", {"BENCH_KERNEL_SWEEP": "1"}, 900.0),
     # taps scoped to the top s2d level only (320x480 planes = 153600 px;
     # the next level down is 38400): where the tall-contraction win
     # concentrates, at a severalfold smaller XLA graph than full taps —
@@ -435,6 +457,33 @@ def _run_one(bench, name: str, env: dict, budget: float) -> dict:
             from tools.bench_serve import run_bench
 
             return run_bench(budget_s=budget)
+        if env.get("BENCH_KERNEL_PROBE") == "1":
+            # compile-only Mosaic accept/reject probes for every Pallas
+            # kernel → the per-chip priors file (tools/probe_kernels.py)
+            from tools.probe_kernels import run_and_save
+
+            priors_path = os.environ.get(
+                "DPT_KERNEL_PRIORS", "kernel_priors.json"
+            )
+            return run_and_save(priors_path)
+        if env.get("BENCH_KERNEL_SWEEP") == "1":
+            # kernel-policy phase A/B (tools/bench_kernels.py) at the
+            # reference geometry — in-process, budget-aware; the probe
+            # leg's priors skip Mosaic-rejected cells
+            from distributedpytorch_tpu.ops.kernels import load_priors
+            from tools.bench_kernels import kernel_sweep
+
+            priors_path = os.environ.get(
+                "DPT_KERNEL_PRIORS", "kernel_priors.json"
+            )
+            return kernel_sweep(
+                batch=int(env.get("BENCH_BATCH", 4)),
+                hw=(int(env.get("BENCH_H", 640)), int(env.get("BENCH_W", 960))),
+                widths=(32, 64, 128, 256),
+                steps=5,
+                budget_s=budget,
+                priors=load_priors(priors_path),
+            )
         if env.get("BENCH_DTYPE_SWEEP") == "1":
             # precision-policy grid (tools/bench_dtype.py) at the
             # reference geometry — in-process, budget-aware

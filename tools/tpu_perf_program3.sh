@@ -24,20 +24,35 @@ OUT="${1:-.perf_r05}"
 mkdir -p "$OUT"
 OUT="$(cd "$OUT" && pwd)"
 
+# Per-chip Mosaic kernel priors (ops/kernels.py, docs/PERFORMANCE.md
+# "Kernels"): the kernel_probe bench leg writes compile-only
+# accept/reject verdicts here; every later leg's kernel policy — and a
+# re-generated plan on the NEXT invocation of this script — reads the
+# same file. Exported so bench_multi's in-process legs and any
+# --kernels pallas run resolve engagement through the chip's own
+# verdicts.
+PRIORS="$OUT/kernel_priors.json"
+export DPT_KERNEL_PRIORS="$PRIORS"
+
 # Auto-planner plan (docs/PERFORMANCE.md "Planning"): rank the window's
 # legs by predicted win BEFORE touching the chip. The planner runs on a
 # self-provisioned CPU mesh (zero chip involvement — safe even while
 # holding the window) and is budget-bounded; bench_multi --plan then
 # runs predicted winners first and degrades to its hand order if the
 # plan is missing/stale. Generated once per outdir; delete plan.json to
-# re-plan with a different grid.
+# re-plan with a different grid. When a priors file already exists
+# (resumed window, or a fresh outdir seeded with the last window's
+# verdicts), the plan searches the kernels axis against it — kernel-on
+# points rank with the chip's accept/reject applied, at zero chip time.
 PLAN="$OUT/plan.json"
 if [ ! -f "$PLAN" ]; then
     echo "== generating auto-planner plan (CPU-only)"
+    PLAN_KERNELS=""
+    [ -f "$PRIORS" ] && PLAN_KERNELS="--kernel-priors $PRIORS"
     timeout --signal=TERM 1800 \
         python -m distributedpytorch_tpu plan --out "$PLAN" \
         --strategies singleGPU MP --remat off --dtypes bf16 \
-        --budget-s 1200 \
+        --budget-s 1200 $PLAN_KERNELS \
         || echo "plan generation failed — bench_multi will use its default order"
 fi
 
@@ -55,15 +70,15 @@ RC=1
 for attempt in 1 2 3 4 5 6; do
     echo "== bench_multi invocation $attempt"
     # Belt-and-suspenders only: every config self-bounds via its own
-    # watchdog (sum of budgets = 13830s: 2x1200 + 4x1500 + 30 + 2x2700,
-    # plus per-config liveness probes at up to ~120s each, plus up to
-    # ~515s per retryable failure for the backed-off re-probes a
-    # flapping runtime now gets), so this outer timeout must exceed
-    # that worst case — a SIGTERM here is indistinguishable from a
-    # wedge and would falsely poison-mark a healthy running config
-    # (the exact failure ADVICE r05 flagged when this was 11000s
-    # against the same 13800s sum).
-    timeout --signal=TERM 16800 \
+    # watchdog (sum of budgets = 16590s across the 14 configs: 2x1200 +
+    # 4x1500 + 300 + 600 + 2x900 + 60 + 30 + 2x2700, plus per-config
+    # liveness probes at up to ~120s each, plus up to ~515s per
+    # retryable failure for the backed-off re-probes a flapping runtime
+    # now gets), so this outer timeout must exceed that worst case — a
+    # SIGTERM here is indistinguishable from a wedge and would falsely
+    # poison-mark a healthy running config (the exact failure ADVICE
+    # r05 flagged when this was 11000s against a 13800s sum).
+    timeout --signal=TERM 21600 \
         python -u tools/bench_multi.py --out "$OUT/bench_multi.jsonl" \
         --plan "$PLAN"
     RC=$?
