@@ -392,6 +392,52 @@ class ServeConfig:
     completion_workers: Optional[int] = None
     # SampleCache budget (MiB) for path-keyed request decode; 0 = off.
     host_cache_mb: int = 256
+    # Clipper-style prediction cache (serve/cache.py): exact-match
+    # masks keyed on the decoded-input hash + weights version, bounded
+    # LRU over this byte budget. 0 = off.
+    predict_cache_mb: int = 0
+
+    # -- self-healing (serve/server.py, docs/SERVING.md "Fleet") ------------
+    # In-process dispatch-core relaunch budget: a dead dispatch loop
+    # rebuilds (fresh queue + thread against the same AOT engine) up to
+    # this many times with exponential backoff; exhausted = the server
+    # goes terminal so a process supervisor (elastic --workload serve)
+    # relaunches the whole worker.
+    restart_limit: int = 3
+    restart_backoff_s: float = 0.25
+    # Elastic supervision (dist/elastic.py --workload serve): when set,
+    # the serve worker writes per-rank beat files — the dispatch loop
+    # ticks progress every turn, so a wedged pipeline stops the ticks
+    # and the supervisor's progress timeout catches it. Normally armed
+    # by the supervisor itself.
+    heartbeat_dir: Optional[str] = None
+    heartbeat_interval_s: float = 0.5
+    # Deterministic chaos (utils/faults.py serve sites:
+    # serve_dispatch_death / serve_replica_wedge / serve_decode /
+    # swap_crash) — drills the relaunch and rollback paths.
+    inject_faults: Tuple[str, ...] = ()
+
+    # -- weight rollout (serve/rollout.py) ----------------------------------
+    # Replica groups the candidate canaries on before promotion.
+    canary_replicas: int = 1
+    # Health-watch window: the canary serves real traffic this long
+    # before the gauges + Dice probe judge it.
+    rollout_window_s: float = 5.0
+    # Pinned-sample probe images (paths, decoded through the engine);
+    # empty = gauge-only gating. The canary's masks must score within
+    # rollout_dice_margin of the old weights' masks on these samples.
+    rollout_probe: Tuple[str, ...] = ()
+    rollout_dice_margin: float = 0.02
+    # Poll this checkpoint path and roll out (canaried) whenever the
+    # file is replaced; None = off. The serve CLI's --watch-checkpoint
+    # defaults it to the serving checkpoint's own path.
+    watch_checkpoint: Optional[str] = None
+    watch_poll_s: float = 2.0
+
+    # -- autoscale hint (serve/autoscale.py; recommendation only) -----------
+    # Cadence of the replica-count recommendation (gauge + log line)
+    # from queue-depth/shed hysteresis. 0 = off.
+    autoscale_interval_s: float = 30.0
 
     # -- transport ----------------------------------------------------------
     host: str = "127.0.0.1"

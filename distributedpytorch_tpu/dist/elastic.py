@@ -53,6 +53,21 @@ Chaos drills: ``--chaos SITE[@RANK]:EPOCH:STEP[:COUNT]`` arms a fault
 via ``--inject-fault`` on the FIRST attempt only, so the relaunched
 attempt does not immediately re-kill itself at the same coordinates.
 
+**Serve workload** (``--workload serve``): the same supervision adopts
+serve processes (serve/cli.py) as its second workload — "a dead
+dispatch loop should be a relaunch, not an outage" (ROADMAP), and the
+layer above the server's own in-process core relaunches. Differences
+from training, all mechanical: worker R gets ``--port base+R`` (one
+HTTP front per worker — a shared-nothing fleet behind any TCP load
+balancer), there is no checkpoint resume to append (the serve args
+already carry ``-c``), no step timeline to arm, and no static
+preflight to run (serving is collective-free by construction). The
+beats come from the dispatch loop — it ticks progress every turn, so
+``--progress-timeout`` catches a wedged pipeline (hung device call,
+stalled completions) whose beat *thread* is still alive — and serve
+workers run until failure or :meth:`ElasticSupervisor.request_stop`
+(SIGINT on the CLI), so "every rank exited 0" is a stop, not a result.
+
 Deliberately jax-free: the supervisor process never initializes a
 backend (and never dials a tunneled TPU runtime) — all its knowledge of
 the job comes from exit codes, beat files, and the checkpoint chain on
@@ -71,6 +86,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
@@ -185,6 +201,7 @@ class ElasticSupervisor:
         preflight_timeout_s: float = 300.0,
         trace: bool = True,
         metrics_port: Optional[int] = None,
+        workload: str = "train",
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -192,11 +209,17 @@ class ElasticSupervisor:
             raise ValueError(
                 f"min_ranks must be in [1, {nprocs}], got {min_ranks}"
             )
+        if workload not in ("train", "serve"):
+            raise ValueError(
+                f"workload must be 'train' or 'serve', got {workload!r}"
+            )
+        self.workload = workload
         self.worker_args = list(worker_args)
+        default_cmd = [sys.executable, "-u", "-m", "distributedpytorch_tpu"]
+        if workload == "serve":
+            default_cmd.append("serve")
         self.worker_cmd = list(
-            worker_cmd
-            if worker_cmd is not None
-            else [sys.executable, "-u", "-m", "distributedpytorch_tpu"]
+            worker_cmd if worker_cmd is not None else default_cmd
         )
         self.nprocs = int(nprocs)
         self.min_ranks = int(min_ranks)
@@ -230,11 +253,20 @@ class ElasticSupervisor:
         self.merged_timeline: Optional[str] = None
 
         # resume coordinates, parsed from the worker argv (the trainer's
-        # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt)
-        self.method_tag = _worker_arg(
-            self.worker_args, ("-t", "--train-method"), "singleGPU",
-            abbrev=True,
+        # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt).
+        # A serve fleet has no resume: workers reload their -c checkpoint
+        # themselves, and the tag only labels the report.
+        self.method_tag = (
+            "serve" if self.workload == "serve" else _worker_arg(
+                self.worker_args, ("-t", "--train-method"), "singleGPU",
+                abbrev=True,
+            )
         )
+        # serve worker R binds base+R: one HTTP front per process — a
+        # shared-nothing fleet any TCP load balancer can sit in front of
+        self.base_port = int(_worker_arg(
+            self.worker_args, ("--port",), "8008"
+        )) if self.workload == "serve" else None
         # exact-only on purpose: the trainer has a DISTINCT exact flag
         # --checkpoint (load a .pth), which argparse resolves to itself
         # but a prefix match would misread as --checkpoint-dir and break
@@ -250,6 +282,7 @@ class ElasticSupervisor:
             ckpt_dir = os.path.join(self.cwd or os.getcwd(), ckpt_dir)
         self.checkpoint_dir = ckpt_dir
 
+        self._shutdown = threading.Event()
         self.restarts = 0
         self.world_history: List[int] = []
         self.attempts: List[AttemptResult] = []
@@ -296,13 +329,13 @@ class ElasticSupervisor:
             env["JAX_COMPILATION_CACHE_DIR"] = f"{prefix}_rank{rank}"
         return env
 
-    def _worker_argv(self, attempt: int) -> List[str]:
+    def _worker_argv(self, attempt: int, rank: int = 0) -> List[str]:
         argv = self.worker_cmd + self.worker_args
         argv += [
             "--heartbeat-dir", self._hb_dir(attempt),
             "--heartbeat-interval", str(self.heartbeat_interval_s),
         ]
-        if self.trace:
+        if self.trace and self.workload == "train":
             # one base path per attempt; rank 0 writes it, rank R writes
             # <path>.rankR (train/loop.py) — merged after the run by the
             # trace hub into one rank-disambiguated Perfetto timeline
@@ -310,6 +343,11 @@ class ElasticSupervisor:
         if attempt == 0:
             for spec in self.chaos:
                 argv += ["--inject-fault", spec]
+        if self.workload == "serve":
+            # appended LAST (last occurrence wins): worker R's HTTP
+            # front on base+R regardless of a user-passed --port
+            argv += ["--port", str(self.base_port + rank)]
+            return argv
         # resume from the newest intact retained checkpoint once one
         # exists. Appended LAST so it wins over any user-passed -c
         # (argparse last-occurrence semantics) — a restart must resume
@@ -353,11 +391,10 @@ class ElasticSupervisor:
     # ------------------------------------------------------------------
     def _spawn(self, attempt: int, world: int) -> None:
         port = _free_port()
-        argv = self._worker_argv(attempt)
         os.makedirs(self._hb_dir(attempt), exist_ok=True)
         logger.info(
             "elastic attempt %d: launching %d rank(s): %s",
-            attempt, world, shlex.join(argv),
+            attempt, world, shlex.join(self._worker_argv(attempt, 0)),
         )
         self._procs = []
         self._log_files = []
@@ -367,7 +404,9 @@ class ElasticSupervisor:
                 self._log_files.append(log_f)
                 self._procs.append(
                     subprocess.Popen(
-                        argv,
+                        # per-rank argv: identical for training; serve
+                        # workers differ by their --port assignment
+                        self._worker_argv(attempt, rank),
                         env=self._worker_env(rank, world, port, attempt),
                         cwd=self.cwd,
                         stdout=log_f,
@@ -422,12 +461,23 @@ class ElasticSupervisor:
             except OSError:
                 pass
 
+    def request_stop(self) -> None:
+        """Ask a running supervision loop to stop cleanly: tear down the
+        workers and return 0 with ``final: stopped``. The serve
+        workload's exit path (serve fleets run until told otherwise —
+        SIGINT on the CLI, a test's teardown); also honored mid-watch by
+        training jobs."""
+        self._shutdown.set()
+
     def _watch(self, attempt: int, world: int) -> Dict[int, health.RankHealth]:
         """Block until the attempt resolves: every rank exits 0 (all-ok
-        map) or some rank fails (classified map). Never raises on worker
-        behavior — classification is the contract."""
+        map) or some rank fails (classified map) — or a clean stop is
+        requested (the caller checks ``_shutdown``). Never raises on
+        worker behavior — classification is the contract."""
         started_at = time.time()
         while True:
+            if self._shutdown.is_set():
+                return {r: health.RankHealth(r, "ok") for r in range(world)}
             codes = self._exit_codes()
             if all(rc == 0 for rc in codes.values()):
                 # still consult the beats: a desynced world tears itself
@@ -486,6 +536,12 @@ class ElasticSupervisor:
         from distributedpytorch_tpu.analysis import ANALYSIS_STRATEGIES
         from distributedpytorch_tpu.analysis.preflight import run_preflight
 
+        if self.workload == "serve":
+            # serving is collective-free by construction (independent
+            # single-device replica executables — the same reason
+            # bench_multi's serve config is in the no-combos class):
+            # nothing to verify statically, nothing to pay for
+            return []
         if self.method_tag not in ANALYSIS_STRATEGIES:
             return []
         schedule = _worker_arg(
@@ -559,6 +615,15 @@ class ElasticSupervisor:
                         metrics_server.port)
         try:
             return self._run_supervised()
+        except KeyboardInterrupt:
+            # the serve workload's normal exit (fleets run until told
+            # otherwise); for training it is the operator's call either
+            # way — tear down and record a clean stop, not a failure
+            logger.info("elastic: interrupted — stopping the fleet")
+            self.request_stop()
+            self._teardown()
+            self._write_report(final="stopped")
+            return 0
         finally:
             if metrics_server is not None:
                 metrics_server.close()
@@ -573,6 +638,24 @@ class ElasticSupervisor:
             t0 = time.monotonic()
             self._spawn(attempt, world)
             verdicts = self._watch(attempt, world)
+            if self._shutdown.is_set():
+                # snapshot BEFORE teardown (same reason as the failure
+                # path below): a healthy worker this stop is about to
+                # SIGTERM must not be recorded as if it died on its own
+                codes = self._exit_codes()
+                self._teardown()
+                self.attempts.append(AttemptResult(
+                    attempt=attempt, world=world, ok=True, failures=[],
+                    exit_codes=codes,
+                    duration_s=time.monotonic() - t0,
+                ))
+                self._merge_timelines()
+                self._write_report(final="stopped")
+                logger.info(
+                    "elastic job stopped on request: %d restart(s), "
+                    "world history %s", self.restarts, self.world_history,
+                )
+                return 0
             failed = {r: h for r, h in verdicts.items() if h.failed}
             # snapshot exit codes BEFORE teardown: a healthy survivor the
             # supervisor is about to SIGTERM must not be recorded as if
@@ -676,6 +759,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     )
     ap.add_argument("-n", "--nprocs", type=int, required=True,
                     help="Worker ranks to launch")
+    ap.add_argument("--workload", type=str, default="train",
+                    choices=["train", "serve"],
+                    help="What the workers are: 'train' (the training "
+                         "CLI, checkpoint-resumed relaunches) or "
+                         "'serve' (serve/cli.py HTTP workers, one per "
+                         "--port base+rank; no resume, no preflight — "
+                         "a dead dispatch loop is a relaunch, not an "
+                         "outage)")
     ap.add_argument("--min-ranks", type=int, default=1,
                     help="Never relaunch below this world size")
     ap.add_argument("--max-restarts", type=int, default=3,
@@ -754,6 +845,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         preflight_timeout_s=args.preflight_timeout,
         trace=not args.no_trace,
         metrics_port=args.metrics_port,
+        workload=args.workload,
     )
     return sup.run()
 

@@ -24,6 +24,19 @@ the elastic runtime (`dist/elastic.py` is the supervision half):
     (``rank R: <dead|hung|desynced> at epoch:step``) that replaces the
     wall of channel-shaped tracebacks every survivor used to print.
 
+The same beats cover BOTH supervised workloads (dist/elastic.py):
+training ranks tick from the step loop (epoch/step = training
+coordinates), serve workers tick from the dispatch loop (epoch stays
+0, ``step`` counts completed requests, ``timed`` is true from the
+first turn — AOT compiles happen before serving starts). Serve-shaped
+failure maps onto the existing verdicts with no new states: a dead
+worker process is ``dead``, a frozen process is ``hung`` via beat age,
+and a wedged serve pipeline — dispatch stuck in a device call,
+completions stalled until every in-flight slot is held — stops the
+dispatch loop's ticks while the beat thread survives, which is exactly
+the stale-``progress_time`` ``hung`` verdict (the epoch-skew desync
+rule is vacuous at constant epoch 0).
+
 Deliberately jax-free: the supervisor imports this before any backend
 initializes, and the classifier must be unit-testable with fabricated
 beats (tests/test_health.py).

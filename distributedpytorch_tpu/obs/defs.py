@@ -87,6 +87,28 @@ SERVE_QUEUE_SECONDS = REGISTRY.histogram(
     buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
              5.0),
 )
+SERVE_PREDICT_CACHE = REGISTRY.counter(
+    "dpt_serve_predict_cache_total",
+    "Clipper-style prediction-cache lookups (exact-match on the "
+    "decoded-input hash)", ("result",))
+SERVE_CORE_RESTARTS = REGISTRY.counter(
+    "dpt_serve_core_restarts_total",
+    "In-process dispatch-core relaunches after a dispatch-loop death")
+SERVE_WEIGHTS_VERSION = REGISTRY.gauge(
+    "dpt_serve_weights_version",
+    "Weights version promoted to every replica group (0 = the "
+    "startup checkpoint)")
+SERVE_ROLLOUTS = REGISTRY.counter(
+    "dpt_serve_rollouts_total",
+    "Weight-rollout attempts by outcome "
+    "(promoted/rolled_back/swap_failed/load_failed)", ("outcome",))
+SERVE_ROLLOUT_CANARY = REGISTRY.gauge(
+    "dpt_serve_rollout_canary",
+    "1 while a rollout canary is being health-watched, else 0")
+SERVE_REPLICA_HINT = REGISTRY.gauge(
+    "dpt_serve_replica_hint",
+    "Recommended replica count from queue-depth/shed hysteresis "
+    "(recommendation only — serve/autoscale.py)")
 
 # -- elastic supervisor (recorded by dist/elastic.py; jax-free) -------------
 ELASTIC_RESTARTS = REGISTRY.counter(

@@ -59,12 +59,19 @@ def metrics_response(registry=None):
     return (registry or REGISTRY).expose().encode(), CONTENT_TYPE
 
 
-def healthz_payload(started_t: float, fingerprint: dict, **extra) -> dict:
+def healthz_payload(started_t: float, fingerprint: dict,
+                    ready: bool = True, **extra) -> dict:
     """The /healthz JSON body (status + uptime + fingerprint), shared
     the same way; ``extra`` carries endpoint-specific inventory (the
-    serve front adds its bucket/replica fields)."""
+    serve front adds its bucket/replica fields). ``ready`` is the
+    liveness-vs-readiness split (docs/SERVING.md): a live process that
+    should not receive traffic right now (dispatch core relaunching,
+    rollout canary in flight) answers ``ready: false`` — the serve
+    front pairs that with HTTP 503 so load balancers act on the status
+    code alone."""
     payload = {
-        "status": "ok",
+        "status": "ok" if ready else "unready",
+        "ready": bool(ready),
         "uptime_s": round(time.monotonic() - started_t, 3),
         "fingerprint": fingerprint,
     }

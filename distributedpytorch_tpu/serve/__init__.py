@@ -18,7 +18,17 @@ supervisor can keep alive. Architecture (docs/SERVING.md):
 * ``server.py``     — the dispatch pipeline (pipelined_placement on
                       the request path; completion drain owns every
                       device→host sync — dptlint's ``serve-hot-path``
-                      rule enforces the boundary);
+                      rule enforces the boundary) wrapped in the
+                      in-process supervisor that relaunches a dead
+                      dispatch core instead of dying with it;
+* ``cache.py``      — the Clipper-style exact-match prediction cache
+                      (decoded-input hash + weights version, bounded
+                      LRU) in front of the queue;
+* ``rollout.py``    — health-gated zero-downtime weight rollout:
+                      canary → gauge/Dice watch → promote or roll
+                      back, plus the ``--watch-checkpoint`` poller;
+* ``autoscale.py``  — the replica-count *hint* (recommendation only)
+                      from queue-depth/shed hysteresis;
 * ``metrics.py``    — async per-request accounting (p50/p99, imgs/s);
 * ``cli.py``        — the stdlib HTTP surface.
 

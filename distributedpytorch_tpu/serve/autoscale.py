@@ -1,0 +1,127 @@
+"""Replica autoscale *hint*: a recommendation, never an action.
+
+The Prometheus gauges the serve tier already exports (PR 7: queue
+depth, shed counts, throughput) contain the capacity answer; this
+module reads them on a fixed cadence and publishes what a human — or a
+future autoscaler — should do about it: the
+``dpt_serve_replica_hint`` gauge plus one log line whenever the
+recommendation changes. Actual autoscaling (resizing the replica set,
+re-AOT-compiling buckets on new devices) stays future work
+(ROADMAP.md); this layer exists so the signal is already proven and
+dashboarded when it lands.
+
+Hysteresis, not thresholds: one shed burst must not flap the
+recommendation. Scale-up needs ``up_windows`` consecutive windows with
+shedding (or depth pinned at the high-water mark); scale-down needs
+``down_windows`` consecutive *completely quiet* windows (no sheds, no
+queue depth) — the asymmetry is deliberate, under-provisioning costs
+users and over-provisioning costs only money.
+
+``observe_window`` is the whole policy, a pure function of one
+window's deltas — the unit tests drive it directly with fabricated
+windows and never wait out a cadence (tests/test_serve_fleet.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from distributedpytorch_tpu.obs import defs as obsm
+
+logger = logging.getLogger(__name__)
+
+
+class AutoscaleHint:
+    """Periodic recommendation thread over one server's gauges."""
+
+    def __init__(
+        self,
+        server,
+        interval_s: float = 30.0,
+        up_windows: int = 2,
+        down_windows: int = 6,
+        depth_high: Optional[int] = None,
+    ):
+        self.server = server
+        self.interval_s = max(0.01, float(interval_s))
+        self.up_windows = max(1, int(up_windows))
+        self.down_windows = max(1, int(down_windows))
+        # depth at (or past) one full bucket per replica means every
+        # replica has a complete dispatch waiting behind its current one
+        # — sustained, that is the queue telling us it wants more devices
+        self.depth_high = (
+            int(depth_high) if depth_high is not None
+            else server.engine.planner.max_size * server.engine.num_replicas
+        )
+        self.recommendation = server.engine.num_replicas
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_shed_total = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        obsm.SERVE_REPLICA_HINT.set(self.recommendation)
+
+    # -- the policy (pure per-window; unit-testable without threads) ---------
+    def observe_window(self, shed_delta: int, max_depth: int) -> int:
+        """Fold one window's observations into the recommendation."""
+        replicas = self.server.engine.num_replicas
+        pressured = shed_delta > 0 or max_depth >= self.depth_high
+        quiet = shed_delta == 0 and max_depth == 0
+        self._up_streak = self._up_streak + 1 if pressured else 0
+        self._down_streak = self._down_streak + 1 if quiet else 0
+        if self._up_streak >= self.up_windows:
+            rec = replicas + 1
+        elif self._down_streak >= self.down_windows and replicas > 1:
+            rec = replicas - 1
+        else:
+            rec = replicas
+        if rec != self.recommendation:
+            logger.info(
+                "serve autoscale hint: recommend %d replica(s) "
+                "(serving with %d) — %s over the last window(s) "
+                "(shed=%d, max_depth=%d, cap=%d); recommendation only, "
+                "no action taken",
+                rec, replicas,
+                "sustained pressure" if rec > replicas else "sustained idle",
+                shed_delta, max_depth, self.depth_high,
+            )
+        self.recommendation = rec
+        obsm.SERVE_REPLICA_HINT.set(rec)
+        return rec
+
+    # -- cadence -------------------------------------------------------------
+    def start(self) -> "AutoscaleHint":
+        self._last_shed_total = self._shed_total()
+        self._thread = threading.Thread(
+            target=self._run, name="dpt-serve-autoscale", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _shed_total(self) -> int:
+        snap = self.server.metrics.snapshot()
+        return int(snap["rejected"].get("overloaded", 0))
+
+    def _run(self) -> None:
+        # sample depth a few times within each window so a burst between
+        # cadence ticks still registers as pressure
+        sub = max(0.005, self.interval_s / 8.0)
+        while not self._stop.is_set():
+            max_depth = 0
+            deadline = time.monotonic() + self.interval_s
+            while time.monotonic() < deadline and not self._stop.wait(sub):
+                max_depth = max(max_depth, self.server.queue.depth_images)
+            if self._stop.is_set():
+                return
+            shed_total = self._shed_total()
+            self.observe_window(shed_total - self._last_shed_total,
+                                max_depth)
+            self._last_shed_total = shed_total

@@ -1,0 +1,111 @@
+"""Clipper-style prediction cache: exact-match masks, in front of the
+queue.
+
+Clipper (NSDI '17) put a prediction cache between the frontend and the
+model containers: repeated traffic over identical inputs — the shape a
+CDN miss storm or a hot object produces — answers from memory instead
+of spending accelerator time. This is that layer for the serve tier:
+
+* **keyed on the decoded-input hash** — the request's decoded float32
+  rows (the same bytes the ``SampleCache`` decode path produces), so
+  two byte-different JPEGs that decode to the same tensor still hit,
+  and a path-keyed and an inline-upload of the same image share an
+  entry;
+* **versioned** — the key includes the engine's promoted
+  ``weights_version``, so a weight rollout implicitly invalidates every
+  cached mask (stale entries become unreachable and LRU-age out), and
+  lookups are bypassed entirely while a canary has the replica groups
+  serving *different* versions (one key, two answers);
+* **bounded** — an LRU over a byte budget (``--predict-cache-mb``):
+  masks are ``(H, W) uint8``, so the budget translates directly to
+  entries; a long-running server never grows memory per distinct input.
+
+Thread-safe: HTTP handler threads look up concurrently while completion
+workers insert. Hit/miss counters ride the process-wide registry
+(``dpt_serve_predict_cache_total{result=...}`` in ``/metrics``) and the
+per-server ``/stats`` snapshot.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from distributedpytorch_tpu.obs import defs as obsm
+
+
+def request_key(rows: Sequence[np.ndarray], weights_version: int) -> str:
+    """The exact-match cache key: sha256 over the decoded rows' bytes +
+    shapes, scoped to the weights version that would answer it."""
+    h = hashlib.sha256()
+    h.update(f"v{int(weights_version)}".encode())
+    for row in rows:
+        arr = np.ascontiguousarray(row)
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+class PredictionCache:
+    """Bounded-byte LRU of served masks, keyed by :func:`request_key`."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._items: "collections.OrderedDict[str, List[np.ndarray]]" = (
+            collections.OrderedDict()
+        )
+        self._lock = threading.Lock()
+        self.used_bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _nbytes(masks: List[np.ndarray]) -> int:
+        return sum(int(m.nbytes) for m in masks)
+
+    def get(self, key: str) -> Optional[List[np.ndarray]]:
+        with self._lock:
+            masks = self._items.get(key)
+            if masks is None:
+                self.misses += 1
+                obsm.SERVE_PREDICT_CACHE.labels(result="miss").inc()
+                return None
+            self._items.move_to_end(key)  # LRU touch
+            self.hits += 1
+            obsm.SERVE_PREDICT_CACHE.labels(result="hit").inc()
+            return masks
+
+    def put(self, key: str, masks: List[np.ndarray]) -> bool:
+        """Store (evicting LRU entries past the budget); returns whether
+        it was stored. Oversized single entries are refused rather than
+        flushing the whole cache for one giant request."""
+        size = self._nbytes(masks)
+        if size > self.budget_bytes:
+            return False
+        with self._lock:
+            old = self._items.pop(key, None)
+            if old is not None:
+                self.used_bytes -= self._nbytes(old)
+            self._items[key] = masks
+            self.used_bytes += size
+            while self.used_bytes > self.budget_bytes and self._items:
+                _k, evicted = self._items.popitem(last=False)
+                self.used_bytes -= self._nbytes(evicted)
+        return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "entries": len(self._items),
+                "bytes": self.used_bytes,
+            }

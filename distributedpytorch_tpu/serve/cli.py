@@ -8,21 +8,34 @@ handler threads, which is exactly the concurrency shape the batching
 layer exists for. Endpoints:
 
 * ``POST /predict`` — body: one image (any PIL-decodable format) →
-  ``image/png`` mask ({0, 255}); ``503`` + JSON when shed capacity is
-  exhausted (body carries the rejection reason), ``400`` on an
-  undecodable body.
-* ``GET /healthz``  — liveness + the compiled bucket/replica inventory,
-  ``uptime_s``, and the build/config fingerprint.
+  ``image/png`` mask ({0, 255}); ``503`` + JSON (with a ``Retry-After``
+  header) when shed or mid-relaunch (body carries the rejection
+  reason), ``400`` on an undecodable body.
+* ``GET /healthz``  — **readiness**: 200 + the compiled bucket/replica
+  inventory, ``uptime_s``, ``weights_version``, and the build/config
+  fingerprint while serving; **503 + ``ready: false``** while the
+  dispatch core is relaunching or a rollout canary is in flight.
+* ``GET /livez``    — pure liveness: 200 as long as the process answers.
 * ``GET /stats``    — the metrics snapshot (p50/p99, imgs/s, queue
-  depth, per-bucket dispatch counts, pad ratio). Schema pinned by
+  depth, per-bucket dispatch counts, pad ratio, ``weights_version``,
+  ``state``, prediction-cache counters). Schema pinned by
   tests/test_serve.py — dashboards depend on it.
 * ``GET /metrics``  — Prometheus text exposition of the process-wide
   telemetry registry (distributedpytorch_tpu/obs, docs/OBSERVABILITY.md).
+* ``POST /admin/rollout`` — ``{"checkpoint": <path>}``: hot-swap a new
+  checkpoint into the running engine through the canary state machine
+  (serve/rollout.py) — 202 accepted, 409 if one is already in flight.
+  ``GET`` returns the rollout status.
 
 Example:
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008 \\
         --buckets 1 2 4 8 --slo-ms 50 --replicas 4
     curl -s --data-binary @car.jpg localhost:8008/predict > mask.png
+
+Supervised fleet launch (dist/elastic.py — a dead worker is a
+relaunch, not an outage; worker R binds ``--port base+R``):
+    python -m distributedpytorch_tpu elastic --workload serve -n 4 -- \\
+        -c singleGPU --port 8008 --replicas 1
 """
 
 from __future__ import annotations
@@ -105,6 +118,52 @@ def get_args(argv=None):
                         help="Disable work-conserving dispatch: wait for "
                              "full buckets or the SLO even when replicas "
                              "are idle (throughput-biased)")
+    parser.add_argument("--predict-cache-mb", type=int, default=0,
+                        help="Clipper-style prediction cache budget "
+                             "(MiB): exact-match masks keyed on the "
+                             "decoded-input hash + weights version; "
+                             "0 = off")
+    parser.add_argument("--restart-limit", type=int, default=3,
+                        help="In-process dispatch-core relaunches before "
+                             "the worker goes terminal (a process "
+                             "supervisor owns the next level)")
+    parser.add_argument("--restart-backoff", type=float, default=0.25,
+                        help="Base core-relaunch backoff seconds "
+                             "(doubles per consecutive restart)")
+    parser.add_argument("--canary-replicas", type=int, default=1,
+                        help="Replica groups a rollout canaries on "
+                             "before promoting to the rest")
+    parser.add_argument("--rollout-window", type=float, default=5.0,
+                        help="Canary health-watch window (seconds)")
+    parser.add_argument("--rollout-probe", type=str, nargs="+",
+                        default=None, metavar="IMAGE",
+                        help="Pinned probe images: a rollout candidate's "
+                             "masks must score within --rollout-dice-"
+                             "margin of the old weights' masks on these")
+    parser.add_argument("--rollout-dice-margin", type=float, default=0.02)
+    parser.add_argument("--watch-checkpoint", type=str, nargs="?",
+                        const="", default=None, metavar="PATH",
+                        help="Poll a checkpoint file and roll it out "
+                             "(canaried) whenever it is replaced; "
+                             "without PATH, watches the serving "
+                             "checkpoint's own file")
+    parser.add_argument("--watch-poll", type=float, default=2.0,
+                        help="Checkpoint-watch poll cadence (seconds)")
+    parser.add_argument("--autoscale-interval", type=float, default=30.0,
+                        help="Cadence of the replica-count "
+                             "recommendation (gauge + log line; "
+                             "recommendation only). 0 = off")
+    parser.add_argument("--heartbeat-dir", type=str, default=None,
+                        help="Write per-rank beat files here for the "
+                             "elastic supervisor (normally armed by "
+                             "elastic --workload serve)")
+    parser.add_argument("--heartbeat-interval", type=float, default=0.5)
+    parser.add_argument("--inject-fault", action="append", default=[],
+                        metavar="SITE[:EPOCH:STEP[:COUNT]]",
+                        help="Arm a deterministic chaos fault "
+                             "(utils/faults.py serve sites: "
+                             "serve_dispatch_death, serve_replica_wedge, "
+                             "serve_decode, swap_crash)")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8008)
     return parser.parse_args(argv)
@@ -135,16 +194,76 @@ def to_config(args):
         inflight_per_replica=args.inflight_per_replica,
         completion_workers=args.completion_workers,
         host_cache_mb=args.host_cache_mb,
+        predict_cache_mb=args.predict_cache_mb,
+        restart_limit=args.restart_limit,
+        restart_backoff_s=args.restart_backoff,
+        canary_replicas=args.canary_replicas,
+        rollout_window_s=args.rollout_window,
+        rollout_probe=tuple(args.rollout_probe or ()),
+        rollout_dice_margin=args.rollout_dice_margin,
+        watch_checkpoint=args.watch_checkpoint,
+        watch_poll_s=args.watch_poll,
+        autoscale_interval_s=args.autoscale_interval,
+        heartbeat_dir=args.heartbeat_dir,
+        heartbeat_interval_s=args.heartbeat_interval,
+        inject_faults=tuple(args.inject_fault),
         host=args.host,
         port=args.port,
     )
 
 
 def build_server(args):
-    """args → started-able :class:`Server` (engine AOT-compiles here)."""
+    """args → started-able :class:`Server` (engine AOT-compiles here),
+    with the fleet components attached: rollout manager (+ optional
+    checkpoint watcher), autoscale hint, armed chaos faults."""
     from distributedpytorch_tpu.serve.server import Server
 
-    return Server.from_config(to_config(args))
+    cfg = to_config(args)
+    if cfg.inject_faults:
+        from distributedpytorch_tpu.utils import faults
+
+        faults.install(cfg.inject_faults)
+    server = Server.from_config(cfg)
+    attach_fleet(server, cfg)
+    return server
+
+
+def attach_fleet(server, cfg) -> None:
+    """Wire the rollout manager, checkpoint watcher, and autoscale hint
+    onto a built server (split out so tests and the bench can attach to
+    servers they construct directly). Components start with the server
+    and stop with ``server.stop()``."""
+    from distributedpytorch_tpu.serve.rollout import (
+        CheckpointWatcher,
+        RolloutManager,
+    )
+
+    probe_rows = [
+        server.engine.preprocess(path) for path in (cfg.rollout_probe or ())
+    ]
+    server.rollout = RolloutManager(
+        server,
+        probe_rows=probe_rows or None,
+        window_s=cfg.rollout_window_s,
+        dice_margin=cfg.rollout_dice_margin,
+        canary_replicas=cfg.canary_replicas,
+    )
+    watch = cfg.watch_checkpoint
+    if watch is not None:
+        if watch == "":  # --watch-checkpoint without a path: watch the
+            # serving checkpoint's own resolved file
+            from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+
+            watch = resolve_checkpoint(cfg.checkpoint, cfg.checkpoint_dir)
+        server.watcher = CheckpointWatcher(
+            server.rollout, watch, poll_s=cfg.watch_poll_s
+        ).start()
+    if cfg.autoscale_interval_s and cfg.autoscale_interval_s > 0:
+        from distributedpytorch_tpu.serve.autoscale import AutoscaleHint
+
+        server.autoscale = AutoscaleHint(
+            server, interval_s=cfg.autoscale_interval_s
+        ).start()
 
 
 def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
@@ -173,25 +292,48 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
     fingerprint = build_fingerprint(getattr(server, "config", None))
 
     class Handler(BaseHTTPRequestHandler):
-        def _json(self, code: int, obj: dict) -> None:
+        def _json(self, code: int, obj: dict,
+                  retry_after: Optional[int] = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if retry_after is not None:
+                # every 503 carries the back-off hint: "relaunching" and
+                # "overloaded" mean retry HERE after this many seconds
+                self.send_header("Retry-After", str(int(retry_after)))
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 — http.server's contract
             if self.path == "/healthz":
-                # shared body builder (obs/http.py: status + uptime +
-                # fingerprint) + this front's compiled inventory
-                self._json(200, healthz_payload(
-                    started_t, fingerprint,
-                    buckets=list(server.engine.planner.sizes),
-                    replicas=server.engine.num_replicas,
-                ))
+                # READINESS (the LB signal): 503 + ready:false while the
+                # dispatch core is between incarnations or a rollout
+                # canary is in flight — /livez stays 200 (don't restart
+                # a process that is busy healing itself)
+                ready = server.ready
+                self._json(
+                    200 if ready else 503,
+                    healthz_payload(
+                        started_t, fingerprint, ready=ready,
+                        state=server.state,
+                        weights_version=server.engine.weights_version,
+                        buckets=list(server.engine.planner.sizes),
+                        replicas=server.engine.num_replicas,
+                    ),
+                    retry_after=None if ready else 1,
+                )
+            elif self.path == "/livez":
+                self._json(200, {"status": "alive"})
             elif self.path == "/stats":
                 self._json(200, server.stats())
+            elif self.path == "/admin/rollout":
+                manager = server.rollout
+                if manager is None:
+                    self._json(404, {"error": "no rollout manager "
+                                              "attached to this server"})
+                else:
+                    self._json(200, manager.status())
             elif self.path == "/metrics":
                 body, ctype = metrics_response()
                 self.send_response(200)
@@ -202,12 +344,41 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
             else:
                 self._json(404, {"error": f"no route {self.path}"})
 
+        def _admin_rollout(self, body: bytes) -> None:
+            from distributedpytorch_tpu.serve.rollout import (
+                RolloutInProgress,
+            )
+
+            manager = server.rollout
+            if manager is None:
+                self._json(404, {"error": "no rollout manager attached "
+                                          "to this server"})
+                return
+            try:
+                spec = json.loads(body or b"{}")
+                checkpoint = spec["checkpoint"]
+            except (ValueError, KeyError, TypeError):
+                self._json(400, {
+                    "error": 'body must be JSON: {"checkpoint": <path>}',
+                })
+                return
+            try:
+                manager.start(checkpoint, label=str(checkpoint))
+            except RolloutInProgress as exc:
+                self._json(409, {"error": str(exc),
+                                 "status": manager.status()})
+                return
+            self._json(202, {"accepted": True, "status": manager.status()})
+
         def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if self.path == "/admin/rollout":
+                self._admin_rollout(body)
+                return
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
-            length = int(self.headers.get("Content-Length", 0))
-            body = self.rfile.read(length)
             try:
                 img = Image.open(io.BytesIO(body))
                 img.load()
@@ -234,7 +405,10 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                         in (STATUS_REJECTED, STATUS_SHUTDOWN) else 500)
                 self._json(code, {
                     "status": response.status, "reason": response.reason,
-                })
+                }, retry_after=(
+                    server.retry_after_s(response.reason)
+                    if code == 503 else None
+                ))
                 return
             buf = io.BytesIO()
             Image.fromarray(response.masks[0]).save(buf, format="PNG")
@@ -255,9 +429,32 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
 
 
 def main(argv=None) -> int:
+    import os
+
     args = get_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(message)s")
-    server = build_server(args).start()
+    heartbeat = None
+    if args.heartbeat_dir:
+        # beat FIRST — the engine's AOT compiles take long enough that a
+        # supervisor would otherwise read "no beat within the spawn
+        # window" for a perfectly healthy worker
+        from distributedpytorch_tpu.dist.health import Heartbeat
+
+        heartbeat = Heartbeat(
+            args.heartbeat_dir,
+            rank=int(os.environ.get("RANK", "0")),
+            interval_s=args.heartbeat_interval,
+        ).start()
+    server = build_server(args)
+    server.heartbeat = heartbeat
+    if heartbeat is not None:
+        # steady state begins AFTER the engine's AOT compiles (the line
+        # above): refresh progress first, THEN arm the progress-timeout
+        # verdict — flipping `timed` before/during a long cold compile
+        # would read as "hung" and kill-loop a healthy starting worker
+        heartbeat.update(0, 0)
+        heartbeat.timed = True
+    server.start()
     httpd = make_http_server(server, host=args.host, port=args.port)
     host, port = httpd.server_address[:2]
     logger.info(
@@ -269,14 +466,26 @@ def main(argv=None) -> int:
     threading.Thread(  # Ctrl-C must interrupt serve_forever, not a join
         target=httpd.serve_forever, daemon=True,
     ).start()
+    rc = 0
     try:
-        threading.Event().wait()
+        # wake periodically: a server whose in-process restart budget is
+        # spent is TERMINAL — exit nonzero so the process supervisor
+        # (elastic --workload serve) relaunches the whole worker
+        from distributedpytorch_tpu.serve.server import STATE_STOPPED
+
+        while server.state != STATE_STOPPED:
+            threading.Event().wait(0.5)
+        logger.error("serve worker terminal (dispatch-core restart "
+                     "budget spent) — exiting for relaunch")
+        rc = 1
     except KeyboardInterrupt:
         logger.info("shutting down (draining queue)")
     finally:
         httpd.shutdown()
         server.stop(drain=True)
-    return 0
+        if heartbeat is not None:
+            heartbeat.stop()
+    return rc
 
 
 if __name__ == "__main__":

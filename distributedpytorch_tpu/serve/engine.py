@@ -51,13 +51,16 @@ logger = logging.getLogger(__name__)
 @dataclasses.dataclass
 class Replica:
     """One device's serving state: weights resident on ``device`` and one
-    compiled executable per bucket size."""
+    compiled executable per bucket size. ``weights_version`` tracks which
+    hot-swap generation this replica serves (0 = the startup weights) —
+    during a rollout canary the groups legitimately diverge."""
 
     index: int
     device: object
     sharding: object
     variables: object
     compiled: Dict[int, object]
+    weights_version: int = 0
 
 
 class ServeEngine:
@@ -83,9 +86,19 @@ class ServeEngine:
         from distributedpytorch_tpu.ops.kernels import get_kernel_policy
 
         self.planner = BucketPlanner(bucket_sizes)
+        self.model = model
         self.input_hw = (int(input_hw[0]), int(input_hw[1]))
         self.threshold = float(threshold)
         self.channels = int(channels)
+        # set by engine_from_checkpoint: loads a NEW checkpoint with this
+        # engine's exact model identity/quantization for a weight rollout
+        # (serve/rollout.py); raw-built engines swap via arrays directly
+        self.bundle_loader = None
+        # monotonic over the engine's lifetime and NEVER rewound by a
+        # rollback — version numbers are cache-key material (serve/
+        # cache.py), so a rejected candidate's number must not be reused
+        # by the next candidate
+        self._version_counter = 0
         self.cache = (
             SampleCache(host_cache_mb * 2**20) if host_cache_mb > 0 else None
         )
@@ -157,6 +170,85 @@ class ServeEngine:
     @property
     def num_replicas(self) -> int:
         return len(self.replicas)
+
+    # -- zero-downtime weight hot-swap (serve/rollout.py drives this) --------
+    @property
+    def weights_version(self) -> int:
+        """The version serving on EVERY replica group — what ``/stats``
+        reports. During a canary the groups diverge; the promoted
+        version is the fleet-wide floor."""
+        return min(r.weights_version for r in self.replicas)
+
+    @property
+    def versions_mixed(self) -> bool:
+        """True while replica groups serve different weight versions (a
+        rollout canary is in flight) — the prediction cache bypasses
+        itself then, since one key would map to two answers."""
+        versions = {r.weights_version for r in self.replicas}
+        return len(versions) > 1
+
+    def next_weights_version(self) -> int:
+        """A fresh, never-reused version number for a rollout candidate
+        (rollbacks rewind replica versions, never this counter)."""
+        return self._version_counter + 1
+
+    def swap_weights(self, params, model_state=None, version: int = 0,
+                     replica_indices: Optional[Sequence[int]] = None) -> None:
+        """``device_put`` a new weight tree into the running replicas —
+        no recompile, no drain: the AOT executables take ``variables`` as
+        an *argument*, so the next dispatch simply passes the new tree
+        (an in-flight dispatch keeps its old reference — the swap is a
+        host-side pointer flip, atomic per replica).
+
+        ``params`` must match the engine's compiled tree structure: a
+        float engine takes float params, an int8 engine takes a
+        quantized tree (``bundle_loader`` enforces this for checkpoint
+        sources). The ``swap_crash`` chaos site fires per replica BEFORE
+        its assignment, so an injected crash leaves that replica — and
+        every later one — still serving the old weights."""
+        import jax
+
+        from distributedpytorch_tpu.utils import faults
+
+        variables = bundle_variables(self.model, params, model_state)
+        indices = (list(range(self.num_replicas))
+                   if replica_indices is None else list(replica_indices))
+        self._version_counter = max(self._version_counter, int(version))
+        for i in indices:
+            replica = self.replicas[i]
+            if faults.fire("swap_crash", step=i):
+                raise faults.InjectedFault(
+                    f"injected swap_crash at replica {i}"
+                )
+            vars_dev = jax.device_put(variables, replica.sharding)
+            # version BEFORE variables, matching the dispatch loop's
+            # variables-then-version read order: the racing pair can
+            # then read (old vars, new version) — a skipped cache put —
+            # but never (new vars, old version), which would cache a
+            # candidate's mask under the promoted version's key
+            replica.weights_version = int(version)
+            replica.variables = vars_dev
+
+    def restore_weights(self, saved: Dict[int, tuple]) -> None:
+        """Roll back replicas to snapshots taken by
+        :meth:`snapshot_weights` (the canary-rollback path — the old
+        device trees were never freed, so this is another pointer flip).
+        Same version-before-variables write order as ``swap_weights``;
+        the version counter never rewinds."""
+        for i, (variables, version) in saved.items():
+            replica = self.replicas[i]
+            replica.weights_version = version
+            replica.variables = variables
+
+    def snapshot_weights(
+        self, replica_indices: Optional[Sequence[int]] = None
+    ) -> Dict[int, tuple]:
+        indices = (list(range(self.num_replicas))
+                   if replica_indices is None else list(replica_indices))
+        return {
+            i: (self.replicas[i].variables, self.replicas[i].weights_version)
+            for i in indices
+        }
 
     # -- request path pieces (the server wires these together) ---------------
     def place(self, replica: Replica, batch: np.ndarray):
@@ -257,4 +349,27 @@ def engine_from_checkpoint(
         model_arch=model_arch, model_widths=model_widths,
         s2d_levels=s2d_levels, quantize=quantize,
     )
-    return ServeEngine.from_bundle(bundle, **engine_kwargs)
+    engine = ServeEngine.from_bundle(bundle, **engine_kwargs)
+
+    def _load_for_swap(new_checkpoint: str):
+        """Load a rollout candidate with THIS engine's model identity and
+        quantization (a float engine must not be handed an int8 tree —
+        the compiled executables' argument structure would mismatch)."""
+        new = load_inference_bundle(
+            new_checkpoint, checkpoint_dir=checkpoint_dir,
+            image_size=image_size, model_arch=model_arch,
+            model_widths=model_widths, s2d_levels=s2d_levels,
+            quantize="int8" if engine.quantized else None,
+        )
+        if new.quantized != engine.quantized:
+            raise ValueError(
+                f"{new_checkpoint} is "
+                f"{'int8' if new.quantized else 'float'} but the engine "
+                f"serves {'int8' if engine.quantized else 'float'} "
+                f"weights — a hot-swap cannot change the executable's "
+                f"argument structure"
+            )
+        return new
+
+    engine.bundle_loader = _load_for_swap
+    return engine

@@ -62,6 +62,7 @@ class ServeMetrics:
         self._images_ok = 0
         self._requests_ok = 0
         self._requests_failed = 0
+        self._requests_cached = 0
         self._rejections: Dict[str, int] = {}
         self._bucket_dispatches: Dict[int, int] = {}
         self._pad_rows = 0
@@ -87,6 +88,14 @@ class ServeMetrics:
         with self._lock:
             self._requests_failed += 1
         obsm.SERVE_REQUESTS.labels(status="failed").inc()
+
+    def record_cached(self, n_images: int) -> None:
+        """A prediction-cache hit answered without touching the queue —
+        counted apart from ``requests_ok`` so hit traffic can't inflate
+        the accelerator-throughput story (``imgs_per_s``)."""
+        with self._lock:
+            self._requests_cached += 1
+        obsm.SERVE_REQUESTS.labels(status="cached").inc()
 
     def record_rejection(self, reason: str) -> None:
         with self._lock:
@@ -118,6 +127,7 @@ class ServeMetrics:
             return {
                 "requests_ok": self._requests_ok,
                 "requests_failed": self._requests_failed,
+                "requests_cached": self._requests_cached,
                 "rejected": dict(self._rejections),
                 "rejected_total": sum(self._rejections.values()),
                 "images_ok": self._images_ok,

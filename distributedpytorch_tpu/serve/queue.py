@@ -75,6 +75,13 @@ class ServeRequest:
     enqueue_t: float = 0.0
     deadline_t: float = 0.0
     seq: int = 0
+    # prediction-cache key (serve/cache.py) stamped at admission when
+    # the cache is on — the completion drain stores the masks under it,
+    # but only when the weights version the dispatch actually used
+    # (read in the dispatch loop) still equals the version the key was
+    # scoped to; a canary/rollback in between must not poison the cache
+    cache_key: Optional[str] = None
+    cache_version: int = 0
 
 
 class BatchingQueue:

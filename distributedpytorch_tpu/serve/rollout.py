@@ -1,0 +1,402 @@
+"""Health-gated zero-downtime weight rollout: canary → watch → promote
+or roll back.
+
+Weights are AOT executable *arguments*, not baked constants
+(serve/engine.py), so a newly trained — or freshly int8-quantized —
+checkpoint rolls into the running engine as a ``device_put`` with no
+recompile and no drain. What makes that safe to do mid-traffic is the
+canary state machine this module owns:
+
+1. **load** — the candidate loads off the serving path
+   (``engine.bundle_loader`` pins the engine's model identity and
+   quantization; a tree-shape mismatch fails HERE, not inside a live
+   dispatch);
+2. **canary** — the new weights swap onto the first
+   ``canary_replicas`` replica group(s) only; the rest keep serving the
+   promoted version (the prediction cache bypasses itself while the
+   groups disagree);
+3. **watch** — over ``window_s`` the manager scores the canary on the
+   PR-7 gauges (error-response and shed deltas, p99 against the
+   pre-canary baseline) plus a **pinned-sample Dice probe**: the probe
+   images run through the canary replica directly (no queue capacity
+   consumed) and their masks must score within ``dice_margin`` of the
+   old weights' masks (or of explicit reference masks, when given);
+4. **promote / roll back** — pass → the remaining groups swap and the
+   promoted ``weights_version`` bumps (``/stats``, ``/metrics``); fail
+   → the canary group's old device trees (never freed — rollback is a
+   pointer flip) are restored and the old version keeps serving.
+
+Every transition lands in the flight-recorder ring and the
+``dpt_serve_rollouts_total``/``dpt_serve_rollout_canary`` families. The
+``swap_crash`` chaos site (utils/faults.py) fires inside the swap
+itself, so the crash-mid-rollout path is deterministically drillable on
+CPU (tests/test_serve_fleet.py).
+
+``--watch-checkpoint`` mode (:class:`CheckpointWatcher`) polls a
+checkpoint path and triggers this exact state machine whenever the
+trainer (or tools/quantize.py) replaces the file — continuous delivery
+for weights, gated by the same canary.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
+
+logger = logging.getLogger(__name__)
+
+STATE_IDLE = "idle"
+STATE_LOADING = "loading"
+STATE_CANARY = "canary"
+STATE_PROMOTING = "promoting"
+
+OUTCOME_PROMOTED = "promoted"
+OUTCOME_ROLLED_BACK = "rolled_back"
+OUTCOME_SWAP_FAILED = "swap_failed"
+OUTCOME_LOAD_FAILED = "load_failed"
+
+
+class RolloutInProgress(RuntimeError):
+    """``start`` refused: a rollout is already in flight (one at a
+    time — two concurrent canaries would fight over the same replicas)."""
+
+
+def mask_dice(a: np.ndarray, b: np.ndarray) -> float:
+    """Dice overlap of two served masks (``{0, 255} uint8`` or bool);
+    both-empty scores 1.0 (identical answers must never read as
+    regression)."""
+    fa = np.asarray(a) > 0
+    fb = np.asarray(b) > 0
+    total = int(fa.sum()) + int(fb.sum())
+    if total == 0:
+        return 1.0
+    return 2.0 * int((fa & fb).sum()) / total
+
+
+class RolloutManager:
+    """One server's rollout state machine (see module docstring).
+
+    ``probe_rows`` are pre-decoded ``(H, W, C) float32`` inputs; when
+    ``probe_refs`` is None the references are the OLD weights' masks on
+    those rows (gate: agreement >= 1 - ``dice_margin``), otherwise the
+    gate is canary Dice >= baseline Dice - ``dice_margin`` against the
+    explicit references (e.g. ground-truth masks).
+    """
+
+    def __init__(
+        self,
+        server,
+        probe_rows: Optional[Sequence[np.ndarray]] = None,
+        probe_refs: Optional[Sequence[np.ndarray]] = None,
+        window_s: float = 5.0,
+        dice_margin: float = 0.02,
+        p99_factor: float = 3.0,
+        p99_floor_ms: float = 250.0,
+        max_error_responses: int = 0,
+        max_shed: Optional[int] = None,
+        canary_replicas: int = 1,
+        clock=time.monotonic,
+    ):
+        self.server = server
+        self.engine = server.engine
+        self.probe_rows = list(probe_rows) if probe_rows else []
+        self.probe_refs = list(probe_refs) if probe_refs else None
+        self.window_s = float(window_s)
+        self.dice_margin = float(dice_margin)
+        self.p99_factor = float(p99_factor)
+        # p99 regressions under this absolute floor never fail a canary:
+        # at single-digit-ms latencies the factor gate is pure noise
+        self.p99_floor_ms = float(p99_floor_ms)
+        self.max_error_responses = int(max_error_responses)
+        self.max_shed = max_shed
+        self.canary_replicas = max(1, int(canary_replicas))
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._state = STATE_IDLE
+        self.last_outcome: Optional[str] = None
+        self.last_reason: str = ""
+        self.history: List[dict] = []  # bounded transition log (status())
+
+    # -- status --------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def canarying(self) -> bool:
+        """True while a canary is being health-watched — what flips the
+        HTTP front's readiness to false (docs/SERVING.md)."""
+        return self._state in (STATE_CANARY, STATE_PROMOTING)
+
+    def status(self) -> dict:
+        return {
+            "state": self._state,
+            "weights_version": self.engine.weights_version,
+            "last_outcome": self.last_outcome,
+            "last_reason": self.last_reason,
+            "history": self.history[-10:],
+        }
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, source, label: str = "") -> None:
+        """Begin a rollout. ``source`` is a checkpoint path/name (loaded
+        through ``engine.bundle_loader``) or a ``(params, model_state)``
+        tuple (tests, in-process callers). Returns once the worker
+        thread is launched; raises :class:`RolloutInProgress` if one is
+        already running. ``wait()`` blocks for the verdict."""
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                raise RolloutInProgress(
+                    f"a rollout is already {self._state}"
+                )
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, args=(source, label or str(source)[:120]),
+                name="dpt-serve-rollout", daemon=True,
+            )
+            self._thread.start()
+
+    def wait(self, timeout: float = 60.0) -> Optional[str]:
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        return self.last_outcome
+
+    def stop(self) -> None:
+        """Abort the watch window (an in-flight canary rolls back — an
+        un-judged candidate must not stay promoted-by-default)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=10.0)
+
+    # -- internals -----------------------------------------------------------
+    def _transition(self, state: str, **fields) -> None:
+        self._state = state
+        entry = {"state": state, "t": time.time(), **fields}
+        self.history.append(entry)
+        del self.history[:-50]  # bounded
+        flight.record("rollout", **{k: v for k, v in entry.items()
+                                    if k != "t"})
+        logger.info("rollout: %s %s", state,
+                    " ".join(f"{k}={v}" for k, v in fields.items()))
+
+    def _load(self, source) -> Tuple[object, object]:
+        if isinstance(source, tuple):
+            params, model_state = source
+            return params, model_state
+        loader = self.engine.bundle_loader
+        if loader is None:
+            raise ValueError(
+                "this engine was built from raw arrays (no checkpoint "
+                "context) — pass a (params, model_state) tuple instead "
+                "of a checkpoint path"
+            )
+        bundle = loader(str(source))
+        return bundle.params, bundle.model_state
+
+    def _probe_masks(self, replica_index: int) -> List[np.ndarray]:
+        """Pinned-sample masks straight off one replica's executables —
+        no queue admission, no capacity consumed, same code path as a
+        served request's forward + postprocess."""
+        masks: List[np.ndarray] = []
+        chunk = self.engine.planner.max_size
+        for i in range(0, len(self.probe_rows), chunk):
+            batch = np.stack(self.probe_rows[i:i + chunk])
+            out = self.engine.infer(batch, replica_index=replica_index)
+            masks.extend(self.engine.postprocess(out[j])
+                         for j in range(out.shape[0]))
+        return masks
+
+    def _probe_dice(self, replica_index: int,
+                    refs: Sequence[np.ndarray]) -> float:
+        masks = self._probe_masks(replica_index)
+        return float(np.mean([
+            mask_dice(m, r) for m, r in zip(masks, refs)
+        ]))
+
+    def _finish(self, outcome: str, reason: str = "", **fields) -> None:
+        self.last_outcome = outcome
+        self.last_reason = reason
+        obsm.SERVE_ROLLOUTS.labels(outcome=outcome).inc()
+        obsm.SERVE_ROLLOUT_CANARY.set(0)
+        self._transition(STATE_IDLE, outcome=outcome, reason=reason,
+                         **fields)
+
+    def _run(self, source, label: str) -> None:
+        self._transition(STATE_LOADING, label=label)
+        try:
+            params, model_state = self._load(source)
+        except BaseException as exc:  # noqa: BLE001 — a bad candidate is
+            # a verdict, never a crash of the serving process
+            logger.exception("rollout: candidate failed to load")
+            self._finish(OUTCOME_LOAD_FAILED, reason=str(exc)[:300])
+            return
+
+        n = self.engine.num_replicas
+        canary_idx = list(range(min(self.canary_replicas, n)))
+        rest_idx = [i for i in range(n) if i not in canary_idx]
+        # monotonic across rollbacks: a rejected candidate's number is
+        # never reused, so its (version-scoped) prediction-cache entries
+        # can never be mistaken for a later candidate's
+        version = self.engine.next_weights_version()
+        old = self.engine.snapshot_weights()  # rollback is a pointer flip
+
+        # pre-canary baselines: the gauges' zero point + the probe refs
+        base = self.server.metrics.snapshot()
+        refs = self.probe_refs
+        baseline_dice = 1.0
+        if self.probe_rows:
+            if refs is None:
+                refs = self._probe_masks(canary_idx[0])  # old weights
+            else:
+                baseline_dice = self._probe_dice(canary_idx[0], refs)
+
+        obsm.SERVE_ROLLOUT_CANARY.set(1)
+        self._transition(STATE_CANARY, version=version, label=label,
+                         canary_replicas=len(canary_idx))
+        try:
+            self.engine.swap_weights(params, model_state, version=version,
+                                     replica_indices=canary_idx)
+        except BaseException as exc:  # noqa: BLE001 — swap_crash site +
+            # real device_put failures: partially-swapped canaries
+            # restore, the old version never stopped serving
+            logger.exception("rollout: canary swap failed")
+            self.engine.restore_weights({i: old[i] for i in canary_idx})
+            self._finish(OUTCOME_SWAP_FAILED, reason=str(exc)[:300],
+                         version=version)
+            return
+
+        # the health window: real traffic keeps flowing through the
+        # canary group while the clock runs
+        deadline = self.clock() + self.window_s
+        while self.clock() < deadline and not self._stop.is_set():
+            time.sleep(min(0.05, max(self.window_s / 20.0, 0.005)))
+
+        reason = self._judge(base, canary_idx[0], refs, baseline_dice)
+        if self._stop.is_set() and reason is None:
+            reason = "rollout aborted (stop requested)"
+        if reason is not None:
+            self.engine.restore_weights({i: old[i] for i in canary_idx})
+            self._finish(OUTCOME_ROLLED_BACK, reason=reason,
+                         version=version)
+            return
+
+        self._transition(STATE_PROMOTING, version=version)
+        try:
+            if rest_idx:
+                self.engine.swap_weights(params, model_state,
+                                         version=version,
+                                         replica_indices=rest_idx)
+        except BaseException as exc:  # noqa: BLE001 — a promote-time
+            # crash rolls EVERYTHING back: a fleet split across versions
+            # must never be the steady state
+            logger.exception("rollout: promote swap failed — rolling back")
+            self.engine.restore_weights(old)
+            self._finish(OUTCOME_SWAP_FAILED,
+                         reason=f"promote failed: {str(exc)[:250]}",
+                         version=version)
+            return
+        obsm.SERVE_WEIGHTS_VERSION.set(version)
+        self._finish(OUTCOME_PROMOTED, version=version, label=label)
+
+    def _judge(self, base: dict, canary_replica: int,
+               refs: Optional[Sequence[np.ndarray]],
+               baseline_dice: float) -> Optional[str]:
+        """None = the canary passes; otherwise the rollback reason."""
+        snap = self.server.metrics.snapshot()
+        failed_delta = snap["requests_failed"] - base["requests_failed"]
+        if failed_delta > self.max_error_responses:
+            return (f"{failed_delta} error response(s) during the canary "
+                    f"window (budget {self.max_error_responses})")
+        if self.max_shed is not None:
+            shed_delta = (
+                snap["rejected"].get("overloaded", 0)
+                - base["rejected"].get("overloaded", 0)
+            )
+            if shed_delta > self.max_shed:
+                return (f"{shed_delta} request(s) shed during the canary "
+                        f"window (budget {self.max_shed})")
+        base_p99, p99 = base.get("p99_ms"), snap.get("p99_ms")
+        if (base_p99 and p99 and p99 > self.p99_floor_ms
+                and p99 > self.p99_factor * base_p99):
+            return (f"p99 {p99:.1f} ms vs baseline {base_p99:.1f} ms "
+                    f"(> {self.p99_factor:g}x)")
+        if self.probe_rows and refs is not None:
+            canary_dice = self._probe_dice(canary_replica, refs)
+            if canary_dice < baseline_dice - self.dice_margin:
+                return (f"pinned-sample Dice {canary_dice:.4f} vs "
+                        f"baseline {baseline_dice:.4f} "
+                        f"(margin {self.dice_margin:g})")
+        return None
+
+
+class CheckpointWatcher:
+    """``--watch-checkpoint``: poll one checkpoint path and run the
+    rollout state machine whenever the file is replaced (the trainer's
+    writes are atomic tmp+rename, so a changed mtime is a complete
+    file; one extra stable poll guards non-atomic writers). The gate is
+    the manager's — a watched checkpoint that regresses the canary rolls
+    back exactly like a ``POST /admin/rollout`` one."""
+
+    def __init__(self, manager: RolloutManager, path: str,
+                 poll_s: float = 2.0):
+        self.manager = manager
+        self.path = str(path)
+        self.poll_s = max(0.05, float(poll_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._seen_mtime = self._mtime()
+        self.triggered = 0
+
+    def _mtime(self) -> Optional[float]:
+        try:
+            return os.stat(self.path).st_mtime
+        except OSError:
+            return None
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread = threading.Thread(
+            target=self._run, name="dpt-ckpt-watch", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _run(self) -> None:
+        pending: Optional[float] = None
+        while not self._stop.wait(self.poll_s):
+            mtime = self._mtime()
+            if mtime is None or mtime == self._seen_mtime:
+                pending = None
+                continue
+            if pending is None or mtime != pending:
+                pending = mtime  # first sight — wait one poll for quiet
+                continue
+            self._seen_mtime = mtime
+            pending = None
+            self.triggered += 1
+            logger.info("checkpoint watcher: %s changed — starting a "
+                        "canaried rollout", self.path)
+            try:
+                self.manager.start(self.path, label="watch-checkpoint")
+            except RolloutInProgress:
+                logger.warning(
+                    "checkpoint watcher: rollout already in flight — "
+                    "will retry at the next change"
+                )
+                self._seen_mtime = None  # re-trigger on the next poll

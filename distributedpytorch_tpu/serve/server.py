@@ -1,14 +1,16 @@
 """The serve loop: queue → placement worker → replica dispatch →
-completion drain.
+completion drain — wrapped in an in-process supervisor that relaunches
+a dead dispatch core instead of turning it into an outage.
 
 The request path is the PR-1 training pipeline turned inference-side —
 the same three-thread overlap, with the same discipline about WHO is
 allowed to block on a device value:
 
 * **ingress** (caller threads / HTTP handlers): decode + preprocess
-  (``SampleCache``-backed), admit into the :class:`BatchingQueue`.
-  Rejections resolve the request future immediately with a status —
-  overload is an answer, not an exception.
+  (``SampleCache``-backed), consult the Clipper-style prediction cache
+  (serve/cache.py), admit into the :class:`BatchingQueue`. Rejections
+  resolve the request future immediately with a status — overload is an
+  answer, not an exception.
 * **placement worker** (``utils/prefetch.pipelined_placement`` — the
   PR-1 machinery verbatim): claims a replica in-flight SLOT, stacks +
   pads the flushed group into its bucket shape, and ``device_put``s it
@@ -27,6 +29,22 @@ allowed to block on a device value:
   off pad rows, split per request, threshold to masks, resolve futures,
   stamp metrics. Per-request accounting lives entirely here — the
   dispatch loop stays sync-free.
+
+**Self-healing** (``_supervise``): the dispatch loop dying used to be a
+terminal event — every pending future failed and the server answered
+``shutdown`` until a human restarted the process. Now it is a blip: the
+dying incarnation still resolves every in-flight future (``error``,
+never a hang), then the supervisor thread rebuilds the core — a fresh
+:class:`BatchingQueue` + dispatch thread against the same AOT-compiled
+engine — after exponential backoff, up to ``restart_limit`` times.
+During the gap ``submit`` answers :data:`REJECT_RELAUNCHING` (HTTP 503
+with ``Retry-After`` — "back off and retry HERE, soon") and ``/healthz``
+reports ``ready: false``; budget exhausted → the server goes terminal
+(``shutdown`` — "retry elsewhere") so a process-level supervisor
+(``elastic --workload serve``) can relaunch the whole worker. Chaos
+sites ``serve_dispatch_death`` / ``serve_replica_wedge`` /
+``serve_decode`` (utils/faults.py) make every one of these paths
+deterministically drillable on CPU.
 """
 
 from __future__ import annotations
@@ -34,6 +52,7 @@ from __future__ import annotations
 import concurrent.futures
 import dataclasses
 import logging
+import os
 import queue as queue_mod
 import threading
 import time
@@ -41,8 +60,10 @@ from typing import List, Optional
 
 import numpy as np
 
+from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
 from distributedpytorch_tpu.serve.bucketing import stack_group
+from distributedpytorch_tpu.serve.cache import PredictionCache, request_key
 from distributedpytorch_tpu.serve.engine import Replica, ServeEngine
 from distributedpytorch_tpu.serve.metrics import ServeMetrics
 from distributedpytorch_tpu.serve.queue import (
@@ -50,6 +71,7 @@ from distributedpytorch_tpu.serve.queue import (
     BatchingQueue,
     ServeRequest,
 )
+from distributedpytorch_tpu.utils import faults
 from distributedpytorch_tpu.utils.prefetch import SINGLE, pipelined_placement
 
 logger = logging.getLogger(__name__)
@@ -58,6 +80,17 @@ STATUS_OK = "ok"
 STATUS_REJECTED = "rejected"
 STATUS_ERROR = "error"
 STATUS_SHUTDOWN = "shutdown"
+
+#: Rejection reason while the dispatch core is between incarnations:
+#: "this instance will be back in under a second — back off and retry
+#: HERE" (vs ``shutdown``'s "retry elsewhere"). Surfaces as HTTP 503
+#: with a ``Retry-After`` header.
+REJECT_RELAUNCHING = "relaunching"
+
+#: Server lifecycle states (``/stats`` ``state`` field, readiness).
+STATE_SERVING = "serving"
+STATE_RELAUNCHING = "relaunching"
+STATE_STOPPED = "stopped"
 
 #: _place's "this group already failed and was resolved" marker: the
 #: dispatch loop skips it and keeps serving (None means "stopping" and
@@ -69,13 +102,14 @@ _PLACE_FAILED = object()
 class ServeResponse:
     """What a request's future resolves to. ``masks`` is one
     ``(H, W) uint8 {0, 255}`` array per submitted image (None unless
-    status == "ok")."""
+    status == "ok"). ``cached`` marks prediction-cache hits."""
 
     key: str
     status: str
     reason: str = ""
     masks: Optional[List[np.ndarray]] = None
     latency_ms: float = 0.0
+    cached: bool = False
 
     @property
     def ok(self) -> bool:
@@ -83,7 +117,8 @@ class ServeResponse:
 
 
 def pull(server: "Server", replica: Replica, out, bucket: int,
-         reqs: List[ServeRequest], dispatch_t: float) -> None:
+         reqs: List[ServeRequest], dispatch_t: float,
+         dispatch_version: int = -1) -> None:
     """Completion drain (sanctioned sync point): block on the device
     result, fan masks back out to request futures, record metrics — and
     only THEN return the replica's in-flight slot. Freeing the slot at
@@ -105,10 +140,23 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
             server.metrics.record_request(
                 req.size, req.enqueue_t, dispatch_t, done_t
             )
+            cache_key = getattr(req, "cache_key", None)
+            if (cache_key is not None
+                    and server.predict_cache is not None
+                    and dispatch_version == req.cache_version):
+                # the mask is cacheable only when the weights version
+                # the DISPATCH actually used (read in the dispatch loop,
+                # not here — a rollback completing before this drain
+                # would lie) equals the version the key was scoped to:
+                # a canary-computed mask must never land under the
+                # promoted version's key, even if the canary has since
+                # rolled back
+                server.predict_cache.put(cache_key, masks)
             req.future.set_result(ServeResponse(
                 key=req.key, status=STATUS_OK, masks=masks,
                 latency_ms=(done_t - req.enqueue_t) * 1e3,
             ))
+        server._completed += len(reqs)  # heartbeat progress (serve beats)
     except Exception as exc:  # noqa: BLE001 — a drain failure must fail
         logger.exception("completion drain failed for bucket %d", bucket)
         for req in reqs:  # the requests, never hang their futures
@@ -138,17 +186,30 @@ class Server:
         completion_workers: Optional[int] = None,
         eager_when_idle: bool = True,
         inflight_per_replica: int = 2,
+        restart_limit: int = 3,
+        restart_backoff_s: float = 0.25,
+        predict_cache_mb: int = 0,
         clock=time.monotonic,
     ):
         self.engine = engine
         self.clock = clock
         self.metrics = ServeMetrics(clock=clock)
-        self.queue = BatchingQueue(
-            engine.planner, slo_s=slo_ms / 1e3,
-            hard_cap_images=hard_cap_images, clock=clock,
-        )
+        self.slo_ms = float(slo_ms)
+        self.hard_cap_images = hard_cap_images
+        self.queue = self._new_queue()
         self.placement_depth = int(placement_depth)
         self.eager_when_idle = bool(eager_when_idle)
+        # In-process supervision: how many dispatch-core relaunches this
+        # server may spend over its lifetime (the elastic supervisor owns
+        # the process-level budget above this), and the base backoff
+        # (doubles per consecutive restart).
+        self.restart_limit = int(restart_limit)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.core_restarts = 0
+        self.predict_cache = (
+            PredictionCache(int(predict_cache_mb) * 2**20)
+            if predict_cache_mb and predict_cache_mb > 0 else None
+        )
         # The in-flight slot pool: each replica appears
         # ``inflight_per_replica`` times, a slot is claimed at placement
         # and returned at COMPLETION (see ``pull``). 2 slots/replica =
@@ -173,19 +234,99 @@ class Server:
             thread_name_prefix="dpt-serve-pull",
         )
         self._stop = threading.Event()
+        self._gen_stop = threading.Event()  # current incarnation's stop
+        self._state = STATE_SERVING
         self._thread: Optional[threading.Thread] = None
         self._dispatch_error: Optional[BaseException] = None
+        self._dispatch_seq = 0  # chaos-site step coordinate
+        self._completed = 0  # requests served; heartbeat step counter
+        self.heartbeat = None  # dist/health.Heartbeat when supervised
+        self.rollout = None  # serve/rollout.RolloutManager when attached
         self.config = None  # set by from_config; /healthz fingerprint
 
+    def _new_queue(self) -> BatchingQueue:
+        return BatchingQueue(
+            self.engine.planner, slo_s=self.slo_ms / 1e3,
+            hard_cap_images=self.hard_cap_images, clock=self.clock,
+        )
+
     # -- lifecycle -----------------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def ready(self) -> bool:
+        """Readiness (vs liveness): accepting AND serving traffic now.
+        False while the dispatch core is between incarnations, after the
+        restart budget is spent, during shutdown — and while a rollout
+        canary is being health-watched (the LB hint that this instance
+        is mid-experiment; requests are still answered)."""
+        if self._state != STATE_SERVING:
+            return False
+        rollout = self.rollout
+        return rollout is None or not rollout.canarying
+
     def start(self) -> "Server":
         if self._thread is not None:
             raise RuntimeError("server already started")
         self._thread = threading.Thread(
-            target=self._dispatch_loop, name="dpt-serve-dispatch", daemon=True
+            target=self._supervise, name="dpt-serve-supervise", daemon=True
         )
         self._thread.start()
         return self
+
+    def _supervise(self) -> None:
+        """Run dispatch-core incarnations until a clean stop or the
+        restart budget is spent. Each incarnation gets its own
+        ``BatchingQueue`` and stop event; the engine (the expensive AOT
+        state) is shared across all of them — a relaunch costs a backoff
+        sleep, never a recompile."""
+        while True:
+            gen_stop = self._gen_stop
+            self._dispatch_error = None
+            self._state = STATE_SERVING
+            self._dispatch_loop(self.queue, gen_stop)
+            if self._stop.is_set() or self._dispatch_error is None:
+                return  # clean stop() — not a failure
+            self.core_restarts += 1
+            obsm.SERVE_CORE_RESTARTS.inc()
+            if self.core_restarts > self.restart_limit:
+                self._state = STATE_STOPPED
+                logger.error(
+                    "serve dispatch core died %d times — restart budget "
+                    "(%d) exhausted; going terminal (a process-level "
+                    "supervisor should relaunch this worker)",
+                    self.core_restarts, self.restart_limit,
+                )
+                flight.record("serve_core_terminal",
+                              restarts=self.core_restarts)
+                self._stop.set()
+                return
+            self._state = STATE_RELAUNCHING
+            backoff = self.restart_backoff_s * (
+                2.0 ** (self.core_restarts - 1)
+            )
+            logger.warning(
+                "serve dispatch core died (%s) — relaunching in %.2fs "
+                "(restart %d/%d)",
+                type(self._dispatch_error).__name__, backoff,
+                self.core_restarts, self.restart_limit,
+            )
+            flight.record("serve_core_relaunch",
+                          restart=self.core_restarts, backoff_s=backoff)
+            hb = self.heartbeat
+            if hb is not None:
+                # the relaunch IS progress: keep the supervisor's
+                # stale-progress verdict for wedges, not for recoveries
+                hb.update(0, self._completed)
+            if self._stop.wait(backoff):
+                return
+            # fresh incarnation: new queue (the old one is stopped) +
+            # new stop event; the slot pool self-restores — every error
+            # path of the dead incarnation returned its slot
+            self.queue = self._new_queue()
+            self._gen_stop = threading.Event()
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop serving. ``drain=True`` first waits for the queue to
@@ -204,10 +345,19 @@ class Server:
             limit = time.monotonic() + timeout
             while (time.monotonic() < limit
                    and self._dispatch_error is None
+                   and not self._stop.is_set()
                    and (self.queue.depth_images > 0
                         or self._free.qsize() < self._total_slots)):
                 time.sleep(0.01)
         self._stop.set()
+        self._gen_stop.set()
+        self._state = STATE_STOPPED
+        # fleet components attached by serve/cli.attach_fleet (watcher
+        # and autoscale are plain attrs — absent on bare servers)
+        for attr in ("watcher", "autoscale", "rollout"):
+            component = getattr(self, attr, None)
+            if component is not None:
+                component.stop()
         for req in self.queue.stop():
             if not req.future.done():
                 req.future.set_result(ServeResponse(
@@ -225,7 +375,22 @@ class Server:
         future ALWAYS resolves to a :class:`ServeResponse` — rejection
         and shutdown included."""
         future: concurrent.futures.Future = concurrent.futures.Future()
+        state = self._state
+        if state != STATE_SERVING:
+            # between dispatch-core incarnations ("retry here shortly")
+            # or terminally stopped ("retry elsewhere") — either way an
+            # immediate answer, never a queue entry a dead core strands
+            reason = (REJECT_RELAUNCHING if state == STATE_RELAUNCHING
+                      else REJECT_SHUTDOWN)
+            status = (STATUS_REJECTED if state == STATE_RELAUNCHING
+                      else STATUS_SHUTDOWN)
+            self.metrics.record_rejection(reason)
+            future.set_result(ServeResponse(
+                key=key, status=status, reason=reason,
+            ))
+            return future
         try:
+            faults.maybe_raise_transient("serve_decode")
             rows = self._as_rows(images)
         except Exception as exc:  # noqa: BLE001 — bad input is a response
             self.metrics.record_failure()
@@ -233,9 +398,28 @@ class Server:
                 key=key, status=STATUS_ERROR, reason=str(exc),
             ))
             return future
-        req = ServeRequest(images=rows, future=future, key=key)
+        cache_key = None
+        cache_version = 0
+        if self.predict_cache is not None and not self.engine.versions_mixed:
+            cache_version = self.engine.weights_version
+            cache_key = request_key(rows, cache_version)
+            cached = self.predict_cache.get(cache_key)
+            if cached is not None:
+                self.metrics.record_cached(len(rows))
+                future.set_result(ServeResponse(
+                    key=key, status=STATUS_OK, masks=list(cached),
+                    latency_ms=0.0, cached=True,
+                ))
+                return future
+        req = ServeRequest(images=rows, future=future, key=key,
+                           cache_key=cache_key, cache_version=cache_version)
         reason = self.queue.submit(req)
         if reason is not None:
+            if reason == REJECT_SHUTDOWN and self._state != STATE_STOPPED:
+                # the dispatch core died between our state check and the
+                # queue admit: this instance is RELAUNCHING, not going
+                # away — don't send the client elsewhere over a blip
+                reason = REJECT_RELAUNCHING
             self.metrics.record_rejection(reason)
             # a stopping server answers "shutdown" (retry elsewhere),
             # not "overloaded" (back off and retry HERE)
@@ -245,6 +429,22 @@ class Server:
                 key=key, status=status, reason=reason,
             ))
         return future
+
+    def retry_after_s(self, reason: str) -> int:
+        """The HTTP ``Retry-After`` hint for a 503: a relaunching core
+        is back after its backoff; an overloaded queue drains within
+        ~an SLO; a stopping server wants clients gone for good — give
+        the LB a few seconds to notice."""
+        if reason == REJECT_RELAUNCHING:
+            # mirror _supervise's computation for the CURRENT gap —
+            # core_restarts was already incremented when it began
+            backoff = self.restart_backoff_s * (
+                2.0 ** max(0, self.core_restarts - 1)
+            )
+            return max(1, int(backoff + 0.999))
+        if reason == REJECT_SHUTDOWN:
+            return 5
+        return max(1, int(self.slo_ms / 1e3 + 0.999))
 
     def _as_rows(self, images) -> List[np.ndarray]:
         if isinstance(images, np.ndarray):
@@ -258,19 +458,29 @@ class Server:
         return [self.engine.preprocess(images)]  # path / PIL image
 
     # -- the serve pipeline --------------------------------------------------
-    def _bucket_stream(self):
+    def _bucket_stream(self, queue: BatchingQueue, gen_stop: threading.Event):
         """Flushed groups as prefetch work items. ``eager`` tracks free
         capacity: with an idle replica, batching must never add latency
         (work-conserving); with all replicas busy, the queue keeps
         coalescing toward fuller buckets. The flag is a callable so a
         slot freed MID-wait (``pull`` kicks the queue) flips eager on
-        immediately instead of the request waiting out its SLO."""
+        immediately instead of the request waiting out its SLO.
+
+        Each loop iteration ticks the serve worker's heartbeat (two
+        attribute assignments — dist/health.Heartbeat.update): the loop
+        turns every <=0.25 s when healthy (idle included), so a wedged
+        pipeline — dispatch stuck in a device call, completions stalled
+        until every slot is held — stops the ticks and the elastic
+        supervisor's progress timeout classifies the worker hung."""
 
         def eager() -> bool:
             return self.eager_when_idle and not self._free.empty()
 
-        while not self._stop.is_set():
-            got = self.queue.wait_for_work(timeout=0.25, eager=eager)
+        while not (gen_stop.is_set() or self._stop.is_set()):
+            hb = self.heartbeat
+            if hb is not None:
+                hb.update(0, self._completed)
+            got = queue.wait_for_work(timeout=0.25, eager=eager)
             if got is not None:
                 yield (SINGLE, got)
 
@@ -312,16 +522,20 @@ class Server:
             return _PLACE_FAILED
 
     def _claim_replica(self) -> Optional[Replica]:
-        while not self._stop.is_set():
+        # reads the CURRENT incarnation's stop event from self: the
+        # supervisor only replaces it after this incarnation's stream is
+        # fully drained, so a worker parked here always sees its own
+        while not (self._gen_stop.is_set() or self._stop.is_set()):
             try:
                 return self._free.get(timeout=0.1)
             except queue_mod.Empty:
                 continue
         return None
 
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, queue: BatchingQueue,
+                       gen_stop: threading.Event) -> None:
         stream = pipelined_placement(
-            self._bucket_stream(), self._place,
+            self._bucket_stream(queue, gen_stop), self._place,
             depth=self.placement_depth, name="dpt-serve-place",
         )
         try:
@@ -332,15 +546,35 @@ class Server:
                     continue
                 replica, x_dev, bucket, reqs = placed
                 try:
+                    self._dispatch_seq += 1
+                    if faults.fire("serve_dispatch_death",
+                                   step=self._dispatch_seq):
+                        raise faults.InjectedFault(
+                            "injected serve_dispatch_death"
+                        )
+                    if faults.fire("serve_replica_wedge",
+                                   step=self._dispatch_seq):
+                        # what a hung device call looks like from the
+                        # host: the loop stops turning, beats go stale
+                        time.sleep(float(
+                            os.environ.get("DPT_FAULT_HANG_S", "600")
+                        ))
                     dispatch_t = self.clock()
                     flight.record("serve_dispatch", bucket=bucket,
                                   reqs=len(reqs))
                     out = self.engine.run(replica, x_dev)
+                    # read AFTER run: the executable captured
+                    # replica.variables inside run, and swap_weights
+                    # writes version-then-variables, so this pair can
+                    # race only toward (old vars, new version) — a
+                    # skipped cache put, never a poisoned one
+                    dispatch_version = replica.weights_version
                     self.metrics.record_dispatch(
                         bucket, sum(req.size for req in reqs)
                     )
                     self._completion.submit(
-                        pull, self, replica, out, bucket, reqs, dispatch_t
+                        pull, self, replica, out, bucket, reqs,
+                        dispatch_t, dispatch_version,
                     )
                 except BaseException:
                     # the group in hand would otherwise die with the
@@ -363,8 +597,11 @@ class Server:
             flight.dump("serve_dispatch_death",
                         extra={"error": f"{type(exc).__name__}: "
                                         f"{str(exc)[:200]}"})
-            self._stop.set()  # ends _bucket_stream → the drain below is finite
-            for req in self.queue.stop():
+            # end THIS incarnation only (_supervise decides whether the
+            # server relaunches or goes terminal) — the drain below is
+            # finite because gen_stop ends _bucket_stream
+            gen_stop.set()
+            for req in queue.stop():
                 if not req.future.done():
                     req.future.set_result(ServeResponse(
                         key=req.key, status=STATUS_ERROR, reason=str(exc),
@@ -374,9 +611,10 @@ class Server:
             # placement pipeline when the loop exits would otherwise
             # vanish with their futures unresolved (queue.stop() never
             # sees them — they were already popped). Every exit path has
-            # _stop set (break only follows a stop-time placement miss;
-            # normal exhaustion means _bucket_stream already returned),
-            # so the stream is finite: drain it and resolve stragglers.
+            # a stop event set (break only follows a stop-time placement
+            # miss; normal exhaustion means _bucket_stream already
+            # returned), so the stream is finite: drain it and resolve
+            # stragglers.
             exc = self._dispatch_error
             status = STATUS_ERROR if exc is not None else STATUS_SHUTDOWN
             reason = str(exc) if exc is not None else "shutdown"
@@ -429,6 +667,9 @@ class Server:
             completion_workers=cfg.completion_workers,
             eager_when_idle=cfg.eager_when_idle,
             inflight_per_replica=cfg.inflight_per_replica,
+            restart_limit=getattr(cfg, "restart_limit", 3),
+            restart_backoff_s=getattr(cfg, "restart_backoff_s", 0.25),
+            predict_cache_mb=getattr(cfg, "predict_cache_mb", 0),
         )
         kwargs.update(overrides)
         server = cls(engine, **kwargs)
@@ -444,5 +685,15 @@ class Server:
             "queue_hard_cap_images": self.queue.hard_cap_images,
             "replicas": self.engine.num_replicas,
             "buckets": list(self.engine.planner.sizes),
+            # fleet & rollout additions (docs/SERVING.md): which weight
+            # generation answers, whether this core is between
+            # incarnations, and the prediction cache's story
+            "weights_version": self.engine.weights_version,
+            "state": self._state,
+            "core_restarts": self.core_restarts,
+            "predict_cache": (
+                self.predict_cache.snapshot()
+                if self.predict_cache is not None else None
+            ),
         })
         return snap

@@ -45,9 +45,21 @@ logger = logging.getLogger(__name__)
 #: loop in a long sleep — what a dead collective looks like from the
 #: host) fire in the step loop (train/loop.py) and are usually pinned to
 #: one rank with the ``site@RANK`` spec form.
+#:
+#: The serve tier's chaos sites (docs/SERVING.md "Fleet & rollout")
+#: drill the self-healing paths on CPU: ``serve_dispatch_death`` kills
+#: the dispatch loop (→ in-process core relaunch, serve/server.py),
+#: ``serve_replica_wedge`` wedges a dispatch in a long sleep (what a
+#: hung device call looks like from the host — the supervisor's
+#: progress-timeout verdict), ``serve_decode`` fails one request's
+#: ingress decode, and ``swap_crash`` fails a weight hot-swap mid-
+#: device_put (→ canary rollback, serve/rollout.py). Serve sites carry
+#: no epoch; their ``step`` coordinate is the dispatch sequence number.
 SITES = (
     "decode", "placement", "nan_loss", "ckpt_write", "sigterm",
     "rank_kill", "rank_hang",
+    "serve_dispatch_death", "serve_replica_wedge", "serve_decode",
+    "swap_crash",
 )
 
 

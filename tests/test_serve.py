@@ -396,12 +396,17 @@ class TestStatsSchema:
     retyped one would break them silently."""
 
     STATS_KEYS = {
-        "requests_ok", "requests_failed", "rejected", "rejected_total",
-        "images_ok", "elapsed_s", "imgs_per_s", "p50_ms", "p99_ms",
-        "queue_p50_ms", "bucket_dispatches", "pad_ratio",
+        "requests_ok", "requests_failed", "requests_cached", "rejected",
+        "rejected_total", "images_ok", "elapsed_s", "imgs_per_s",
+        "p50_ms", "p99_ms", "queue_p50_ms", "bucket_dispatches",
+        "pad_ratio",
         # Server.stats() additions on top of the snapshot
         "queue_depth_images", "queue_max_depth_images",
         "queue_hard_cap_images", "replicas", "buckets",
+        # fleet & rollout additions (ISSUE 12): the serving weight
+        # generation, the self-healing core's state, and the
+        # prediction-cache story
+        "weights_version", "state", "core_restarts", "predict_cache",
     }
 
     def test_stats_key_set_and_types_pinned(self, engine):
@@ -467,6 +472,10 @@ class TestBenchServe:
             assert row["p99_ms"] is not None
             assert row["imgs_per_s"] > 0
         assert report["overload"]["depth_bounded"]
+        # fleet legs (ISSUE 12) ride the same report; their own
+        # assertions live in tests/test_serve_fleet.py
+        assert report["chaos"]["recovered"]
+        assert report["rollout"]["outcome"] == "promoted"
         assert (
             report["overload"]["queue_depth_max"]
             <= report["overload"]["queue_depth_cap"]

@@ -209,10 +209,150 @@ def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
     }
 
 
+def _flight_path(args, leg: str) -> str:
+    """Per-leg flight-recorder artifact path (bench_multi's session rows
+    reference these for post-mortems)."""
+    import tempfile
+
+    if args.out:
+        return f"{args.out}.flight_{leg}.json"
+    return os.path.join(tempfile.gettempdir(), f"bench_serve_flight_{leg}.json")
+
+
+def chaos_leg(engine, args, duration_s: float) -> dict:
+    """Self-healing drill: kill the dispatch loop mid-traffic
+    (``serve_dispatch_death``) and measure the relaunch — every future
+    must resolve (never hang), the core must come back, and a
+    post-recovery request must serve. The leg's flight-recorder dump is
+    the same post-mortem artifact a production death leaves."""
+    from distributedpytorch_tpu.obs import flight
+    from distributedpytorch_tpu.utils import faults
+
+    server = _new_server(engine, args)
+    images = make_images(16, engine.input_hw, args.seed)
+    statuses: dict = {}
+    unresolved = 0
+    lock = threading.Lock()
+    stop_at = time.monotonic() + duration_s
+
+    def worker(wid: int) -> None:
+        nonlocal unresolved
+        i = wid
+        while time.monotonic() < stop_at:
+            fut = server.submit(images[i % len(images)], key=f"x{wid}-{i}")
+            try:
+                response = fut.result(timeout=30.0)
+                with lock:
+                    statuses[response.status] = (
+                        statuses.get(response.status, 0) + 1
+                    )
+            except Exception:  # noqa: BLE001 — a hung future is THE failure
+                with lock:
+                    unresolved += 1
+            i += 4
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+               for w in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s * 0.3)
+        faults.install(("serve_dispatch_death",))  # next dispatch dies
+        for t in threads:
+            t.join(timeout=duration_s + 60.0)
+        # recovery probe: the relaunched core must serve again
+        deadline = time.monotonic() + 30.0
+        recovered = False
+        while time.monotonic() < deadline and not recovered:
+            if server.submit(images[0], key="probe").result(30.0).ok:
+                recovered = True
+            else:
+                time.sleep(0.05)
+    finally:
+        faults.reset()
+        artifact = flight.dump("bench_serve_chaos",
+                               path=_flight_path(args, "chaos"))
+        server.stop(drain=True)
+    return {
+        "mode": "chaos",
+        "fault": "serve_dispatch_death",
+        "statuses": statuses,
+        "unresolved_futures": unresolved,
+        "core_restarts": server.core_restarts,
+        "recovered": recovered,
+        "flight_recorder": artifact,
+    }
+
+
+def rollout_leg(engine, args, duration_s: float) -> dict:
+    """Zero-downtime rollout drill: mid-traffic, canary + promote a
+    second set of (seeded fresh-init) weights through the rollout state
+    machine; the interesting numbers are the outcome, the promoted
+    version, and that no request got a 5xx-shaped answer during the
+    swap."""
+    import jax
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+    from distributedpytorch_tpu.obs import flight
+    from distributedpytorch_tpu.serve.rollout import RolloutManager
+
+    widths = tuple(args.model_widths) if args.model_widths else None
+    cfg = TrainConfig(model_arch=args.model_arch, model_widths=widths,
+                      compute_dtype="float32", s2d_levels=args.s2d_levels)
+    _model, init_fn = create_model(cfg)
+    h, w = engine.input_hw
+    new_params, new_state = init_fn(jax.random.key(args.seed + 1), (h, w))
+
+    server = _new_server(engine, args)
+    manager = RolloutManager(
+        server, window_s=max(0.2, duration_s * 0.2), canary_replicas=1,
+    )
+    server.rollout = manager
+    images = make_images(16, engine.input_hw, args.seed)
+    bad = 0
+    ok = 0
+    stop_at = time.monotonic() + duration_s
+    futures = []
+    try:
+        started = False
+        i = 0
+        while time.monotonic() < stop_at:
+            futures.append(server.submit(images[i % len(images)], key=str(i)))
+            i += 1
+            if not started and time.monotonic() > stop_at - duration_s * 0.7:
+                manager.start((new_params, new_state), label="bench")
+                started = True
+            time.sleep(0.005)
+        outcome = manager.wait(timeout=60.0)
+        for fut in futures:
+            response = fut.result(timeout=30.0)
+            if response.ok:
+                ok += 1
+            else:
+                bad += 1
+    finally:
+        artifact = flight.dump("bench_serve_rollout",
+                               path=_flight_path(args, "rollout"))
+        server.stop(drain=True)
+    return {
+        "mode": "rollout",
+        "outcome": outcome,
+        "weights_version": engine.weights_version,
+        "ok": ok,
+        "non_ok": bad,
+        "zero_5xx": bad == 0,
+        "flight_recorder": artifact,
+    }
+
+
 def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None,
               levels: Optional[Sequence[int]] = None) -> dict:
     """The whole program: closed-loop sweep over the concurrency levels,
-    one in-SLO open-loop run, one overload run. Returns the report dict
+    one in-SLO open-loop run, one overload run, then the fleet drills —
+    a chaos leg (dispatch death → relaunch) and a rollout leg
+    (mid-traffic canaried weight swap). Returns the report dict
     (bench_multi appends it to the session artifact verbatim)."""
     args = args or get_args([])
     levels = [int(c) for c in (levels or args.levels)]
@@ -221,8 +361,9 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
     engine = build_engine(args)
     engine.warmup()
 
-    # budget split: levels + 2 open-loop scenarios, capped per-leg
-    legs = len(levels) + 2
+    # budget split: levels + 2 open-loop scenarios + 2 fleet drills,
+    # capped per-leg
+    legs = len(levels) + 4
     leg_s = max(1.0, min(args.duration, (budget_s * 0.8) / legs))
 
     report = {
@@ -256,6 +397,10 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
         label="open_overload",
     )
     print(json.dumps(report["overload"]), flush=True)
+    report["chaos"] = chaos_leg(engine, args, leg_s)
+    print(json.dumps(report["chaos"]), flush=True)
+    report["rollout"] = rollout_leg(engine, args, leg_s)
+    print(json.dumps(report["rollout"]), flush=True)
     report["elapsed_s"] = round(time.monotonic() - t_start, 2)
     report["value"] = capacity  # headline: peak closed-loop imgs/s
     return report
@@ -301,10 +446,16 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(text + "\n")
     print(text)
-    # acceptance: >= 3 levels reported, overload depth bounded
+    # acceptance: >= 3 levels reported, overload depth bounded, the
+    # chaos drill relaunched with zero hung futures, and the mid-traffic
+    # rollout promoted with zero 5xx-shaped answers
     ok = (
         len(report["levels"]) >= 3
         and report["overload"]["depth_bounded"]
+        and report["chaos"]["recovered"]
+        and report["chaos"]["unresolved_futures"] == 0
+        and report["rollout"]["outcome"] == "promoted"
+        and report["rollout"]["zero_5xx"]
     )
     return 0 if ok else 1
 
