@@ -211,10 +211,17 @@ CKPT_RESTORE_SEAMS = frozenset({
 CKPT_RULE_EXEMPT_MODULES = ("checkpoint.py",)
 
 #: The obs record-path scope (rule ``obs-hot-path``): functions with
-#: these names (or any ``record*``) inside ``obs/`` modules are the
-#: always-on recording paths — one ring slot / one counter bump is the
-#: whole allocation budget, and nothing there may touch a device value.
-OBS_RECORD_FN_NAMES = frozenset({"inc", "observe", "set", "span", "fire"})
+#: these names (or any ``record*``/``mark*``) inside ``obs/`` modules
+#: are the always-on recording paths — one ring slot / one counter bump
+#: is the whole allocation budget, and nothing there may touch a device
+#: value. ``mark*`` and the completion verbs cover obs/reqtrace.py's
+#: request-trace lifecycle: ``mark_*`` stamps ride the serve dispatch
+#: hot path, and ``begin``/``complete``/``finish``/``reject`` are the
+#: per-request ledger paths whose appends must be bounded rings.
+OBS_RECORD_FN_NAMES = frozenset({
+    "inc", "observe", "set", "span", "fire",
+    "begin", "complete", "finish", "reject",
+})
 #: Dotted-prefix spellings of telemetry calls (``from ...obs import
 #: flight``, ``from ...obs import defs as obsm``, ``obs.flight.record``)
 #: that must never appear inside a traced function.
@@ -227,7 +234,7 @@ def _is_obs_module(rel_path: str) -> bool:
 
 
 def _is_obs_record_fn(name: str) -> bool:
-    return name.startswith("record") or name in OBS_RECORD_FN_NAMES
+    return name.startswith(("record", "mark")) or name in OBS_RECORD_FN_NAMES
 
 
 def _bounded_append_targets(tree: ast.AST) -> Set[str]:

@@ -439,6 +439,23 @@ class ServeConfig:
     # from queue-depth/shed hysteresis. 0 = off.
     autoscale_interval_s: float = 30.0
 
+    # -- request tracing (obs/reqtrace.py, docs/OBSERVABILITY.md) -----------
+    # End-to-end "good request" latency bound the SLO burn-rate windows
+    # judge against. None = 2x slo_ms (the batching wait plus a
+    # comparable service allowance).
+    latency_slo_ms: Optional[float] = None
+    # Structured-log threshold: any served request slower than this logs
+    # ONE JSON line with its id + full span ledger (and lands in the
+    # flight ring). <= 0 = 2x the latency SLO.
+    slow_request_ms: float = 0.0
+    # Per-request span JSONL (the serve analogue of --trace-timeline on
+    # training runs): rank 0 writes the path, rank R appends .rankR; the
+    # elastic supervisor arms it per attempt and merges the workers into
+    # one fleet Perfetto timeline. None = no span export (the ledger
+    # ring, /stats attribution, slow-request log, and flight-ring
+    # reject/slow events all stay on regardless).
+    trace_timeline: Optional[str] = None
+
     # -- transport ----------------------------------------------------------
     host: str = "127.0.0.1"
     port: int = 8008

@@ -60,8 +60,13 @@ layer above the server's own in-process core relaunches. Differences
 from training, all mechanical: worker R gets ``--port base+R`` (one
 HTTP front per worker — a shared-nothing fleet behind any TCP load
 balancer), there is no checkpoint resume to append (the serve args
-already carry ``-c``), no step timeline to arm, and no static
-preflight to run (serving is collective-free by construction). The
+already carry ``-c``), and no static preflight to run (serving is
+collective-free by construction). The per-attempt ``--trace-timeline``
+IS armed (serve/cli.py writes per-request span ledgers under the same
+rank-suffix convention), merged into one fleet Perfetto timeline with
+"worker R" tracks; with ``--metrics-port`` the supervisor additionally
+scrapes every worker's ``/metrics`` and re-exposes the families merged and
+worker-labeled on its own port — the fleet pane. The
 beats come from the dispatch loop — it ticks progress every turn, so
 ``--progress-timeout`` catches a wedged pipeline (hung device call,
 stalled completions) whose beat *thread* is still alive — and serve
@@ -153,6 +158,81 @@ def _checkpoint_exists(checkpoint_dir: str, tag: str) -> bool:
     if os.path.exists(base):
         return True
     return any(os.path.exists(f"{base}.{i}") for i in range(1, 64))
+
+
+class FleetMetricsScraper:
+    """The fleet pane's ingest half (docs/SERVING.md "Fleet pane"): a
+    daemon thread scraping each serve worker's ``/metrics`` (port
+    base+R) and keeping the latest exposition text per worker. The
+    supervisor's own metrics endpoint re-exposes these merged and
+    worker-labeled (``registry.merge_expositions``), so one scrape
+    target tells the whole shared-nothing fleet's story. A worker that
+    fails its scrape (dead, relaunching, mid-bind) drops out of the
+    pane until it answers again — stale numbers from a dead worker
+    would read as a healthy flatline."""
+
+    def __init__(self, host: str, base_port: int, world_fn,
+                 interval_s: float = 2.0, timeout_s: float = 2.0):
+        self.host = host
+        self.base_port = int(base_port)
+        self.world_fn = world_fn  # () -> current world size
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self._latest: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="dpt-fleet-scrape",
+        )
+
+    def start(self) -> "FleetMetricsScraper":
+        self._thread.start()
+        return self
+
+    def _scrape_worker(self, rank: int) -> Optional[str]:
+        import urllib.request
+
+        url = f"http://{self.host}:{self.base_port + rank}/metrics"
+        try:
+            with urllib.request.urlopen(url, timeout=self.timeout_s) as resp:
+                return resp.read().decode()
+        except Exception:  # noqa: BLE001 — a dead worker is not news
+            return None
+
+    def scrape_once(self) -> Dict[str, str]:
+        """One sweep over the current fleet (also the unit under test).
+        Workers are scraped CONCURRENTLY: serially, every wedged worker
+        would add its full timeout to the sweep and the healthy workers'
+        numbers would go tens of seconds stale on a large fleet — the
+        exact staleness this pane exists to avoid."""
+        import concurrent.futures
+
+        world = max(0, int(self.world_fn()))
+        if world == 0:
+            return {}
+        with concurrent.futures.ThreadPoolExecutor(
+            max_workers=min(world, 16),
+            thread_name_prefix="dpt-fleet-scrape",
+        ) as pool:
+            texts = list(pool.map(self._scrape_worker, range(world)))
+        return {str(r): t for r, t in enumerate(texts) if t is not None}
+
+    def _loop(self) -> None:
+        # sweep IMMEDIATELY: the pane must not serve an empty merged
+        # exposition for the first interval after startup
+        while True:
+            seen = self.scrape_once()
+            with self._lock:
+                self._latest = seen
+            if self._stop.wait(self.interval_s):
+                return
+
+    def latest(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._latest)
+
+    def stop(self) -> None:
+        self._stop.set()
 
 
 @dataclasses.dataclass
@@ -251,6 +331,9 @@ class ElasticSupervisor:
         self.trace = bool(trace)
         self.metrics_port = metrics_port
         self.merged_timeline: Optional[str] = None
+        # fleet pane (serve workload + --metrics-port): the per-worker
+        # /metrics scraper feeding the supervisor's merged exposition
+        self.fleet_scraper: Optional[FleetMetricsScraper] = None
 
         # resume coordinates, parsed from the worker argv (the trainer's
         # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt).
@@ -335,10 +418,12 @@ class ElasticSupervisor:
             "--heartbeat-dir", self._hb_dir(attempt),
             "--heartbeat-interval", str(self.heartbeat_interval_s),
         ]
-        if self.trace and self.workload == "train":
+        if self.trace:
             # one base path per attempt; rank 0 writes it, rank R writes
-            # <path>.rankR (train/loop.py) — merged after the run by the
-            # trace hub into one rank-disambiguated Perfetto timeline
+            # <path>.rankR (train/loop.py for training; serve/cli.py
+            # writes per-request span ledgers under the same convention)
+            # — merged after the run by the trace hub into one
+            # rank/worker-disambiguated Perfetto timeline
             argv += ["--trace-timeline", self._timeline_base(attempt)]
         if attempt == 0:
             for spec in self.chaos:
@@ -368,8 +453,11 @@ class ElasticSupervisor:
     def _merge_timelines(self) -> Optional[str]:
         """Merge every attempt's per-rank timeline JSONL into ONE
         Perfetto trace for the whole supervised job (rank-disambiguated
-        tracks; docs/OBSERVABILITY.md). Never raises — this runs on the
-        report path of jobs that may already be failing."""
+        tracks; docs/OBSERVABILITY.md). A serve fleet's per-request
+        span ledgers merge the same way — its process tracks read
+        "worker R" and the result is the fleet timeline (one pane for N
+        shared-nothing workers). Never raises — this runs on the report
+        path of jobs that may already be failing."""
         if not self.trace:
             return None
         from distributedpytorch_tpu.obs import trace_hub
@@ -380,7 +468,10 @@ class ElasticSupervisor:
                 self._timeline_base(attempt)
             ))
         out = os.path.join(self.run_dir, "timeline_merged.json")
-        self.merged_timeline = trace_hub.write_merged_trace(pairs, out)
+        self.merged_timeline = trace_hub.write_merged_trace(
+            pairs, out,
+            process_label="worker" if self.workload == "serve" else "rank",
+        )
         return self.merged_timeline
 
     def _log_path(self, attempt: int, rank: int) -> str:
@@ -607,12 +698,42 @@ class ElasticSupervisor:
                 self._write_report(final="static_check_failed")
                 return STATIC_CHECK_EXIT
         metrics_server = None
+        fleet_scraper = None
         if self.metrics_port is not None:
             from distributedpytorch_tpu.obs.http import start_metrics_server
 
-            metrics_server = start_metrics_server(self.metrics_port)
-            logger.info("elastic: serving /metrics on port %d",
-                        metrics_server.port)
+            expose_fn = None
+            if self.workload == "serve" and self.base_port is not None:
+                # the fleet pane: scrape every worker's /metrics and
+                # re-expose the families merged + worker-labeled on the
+                # supervisor's own port — one scrape target for N
+                # shared-nothing workers (docs/SERVING.md)
+                from distributedpytorch_tpu.obs.registry import (
+                    REGISTRY,
+                    merge_expositions,
+                )
+
+                host = _worker_arg(self.worker_args, ("--host",),
+                                   "127.0.0.1")
+                fleet_scraper = FleetMetricsScraper(
+                    host, self.base_port,
+                    lambda: (self.world_history[-1]
+                             if self.world_history else self.nprocs),
+                ).start()
+                self.fleet_scraper = fleet_scraper
+
+                def expose_fn():
+                    return merge_expositions(
+                        REGISTRY.expose(), fleet_scraper.latest(),
+                    )
+
+            metrics_server = start_metrics_server(
+                self.metrics_port, expose_text_fn=expose_fn,
+            )
+            logger.info("elastic: serving /metrics on port %d%s",
+                        metrics_server.port,
+                        " (fleet pane: merged worker-labeled families)"
+                        if fleet_scraper is not None else "")
         try:
             return self._run_supervised()
         except KeyboardInterrupt:
@@ -625,6 +746,8 @@ class ElasticSupervisor:
             self._write_report(final="stopped")
             return 0
         finally:
+            if fleet_scraper is not None:
+                fleet_scraper.stop()
             if metrics_server is not None:
                 metrics_server.close()
 
@@ -815,7 +938,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="Serve the supervisor's Prometheus /metrics "
                          "(restarts, world size, per-rank failure "
-                         "classes) on this port")
+                         "classes) on this port; with --workload serve "
+                         "this becomes the FLEET pane — every worker's "
+                         "/metrics scraped and re-exposed merged with "
+                         "worker=\"R\" labels (one scrape target for "
+                         "the whole fleet)")
     ap.add_argument("worker_args", nargs=argparse.REMAINDER,
                     help="Training CLI args (prefix with --)")
     args = ap.parse_args(argv)

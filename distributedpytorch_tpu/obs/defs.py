@@ -18,6 +18,7 @@ The full catalog with semantics lives in docs/OBSERVABILITY.md.
 from __future__ import annotations
 
 from distributedpytorch_tpu.obs.registry import REGISTRY
+from distributedpytorch_tpu.obs.reqtrace import SERVICE_TIME_BOUNDS
 
 # -- train (recorded by train/loop.py + utils/metrics.py at drain
 #    boundaries — never on the dispatch hot path) ---------------------------
@@ -109,6 +110,38 @@ SERVE_REPLICA_HINT = REGISTRY.gauge(
     "dpt_serve_replica_hint",
     "Recommended replica count from queue-depth/shed hysteresis "
     "(recommendation only — serve/autoscale.py)")
+
+# -- request tracing (obs/reqtrace.py; recorded from completion workers
+#    and ingress rejection paths — never the dispatch loop) -----------------
+# one ladder (reqtrace.SERVICE_TIME_BOUNDS) for both: these histograms
+# and the dpt_serve_profile artifact must describe the SAME
+# distribution, or planner calibration drifts from what /metrics shows
+SERVE_PHASE_SECONDS = REGISTRY.histogram(
+    "dpt_serve_phase_seconds",
+    "Per-request phase attribution from the span ledger "
+    "(decode/queue_wait/placement/dispatch_wait/device_exec/drain)",
+    ("phase",),
+    buckets=SERVICE_TIME_BOUNDS,
+)
+SERVE_DEVICE_EXEC = REGISTRY.histogram(
+    "dpt_serve_device_exec_seconds",
+    "Host-observed device execution time per bucket size (the "
+    "per-bucket service-time profile the capacity planner calibrates "
+    "against)",
+    ("bucket",),
+    buckets=SERVICE_TIME_BOUNDS,
+)
+SERVE_SLOW_REQUESTS = REGISTRY.counter(
+    "dpt_serve_slow_requests_total",
+    "Requests above the slow-request threshold (each one structured-"
+    "logged with its full span ledger and request id)")
+SERVE_SLO_BURN_FAST = REGISTRY.gauge(
+    "dpt_serve_slo_burn_fast",
+    "Error-budget burn rate over the fast window (1.0 = spending "
+    "exactly the budget; >1 = on track to exhaust it)")
+SERVE_SLO_BURN_SLOW = REGISTRY.gauge(
+    "dpt_serve_slo_burn_slow",
+    "Error-budget burn rate over the slow window")
 
 # -- elastic supervisor (recorded by dist/elastic.py; jax-free) -------------
 ELASTIC_RESTARTS = REGISTRY.counter(

@@ -80,10 +80,16 @@ def healthz_payload(started_t: float, fingerprint: dict,
 
 
 class MetricsServer:
-    """A started /metrics + /healthz endpoint; ``close()`` to stop."""
+    """A started /metrics + /healthz endpoint; ``close()`` to stop.
+
+    ``expose_text_fn`` overrides what a /metrics scrape returns (still
+    the Prometheus text format) — the elastic serve supervisor passes a
+    closure that merges its own registry with the scraped, worker-
+    labeled fleet expositions (``registry.merge_expositions``)."""
 
     def __init__(self, port: int, host: str = "127.0.0.1",
-                 registry=None, fingerprint: Optional[dict] = None):
+                 registry=None, fingerprint: Optional[dict] = None,
+                 expose_text_fn=None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         fingerprint = fingerprint or build_fingerprint()
@@ -99,7 +105,11 @@ class MetricsServer:
 
             def do_GET(self):  # noqa: N802 — http.server's contract
                 if self.path == "/metrics":
-                    self._send(200, *metrics_response(registry))
+                    if expose_text_fn is not None:
+                        self._send(200, expose_text_fn().encode(),
+                                   CONTENT_TYPE)
+                    else:
+                        self._send(200, *metrics_response(registry))
                 elif self.path == "/healthz":
                     self._send(200, json.dumps(
                         healthz_payload(started_t, fingerprint)
@@ -127,6 +137,8 @@ class MetricsServer:
 
 def start_metrics_server(port: int, host: str = "127.0.0.1",
                          registry=None,
-                         fingerprint: Optional[dict] = None) -> MetricsServer:
+                         fingerprint: Optional[dict] = None,
+                         expose_text_fn=None) -> MetricsServer:
     return MetricsServer(port, host=host, registry=registry,
-                         fingerprint=fingerprint)
+                         fingerprint=fingerprint,
+                         expose_text_fn=expose_text_fn)

@@ -56,6 +56,17 @@ DEFAULT_BUCKETS = (
 DEFAULT_WINDOW = 2048
 
 
+def nearest_rank(ordered: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) over an ALREADY-SORTED
+    non-empty sequence — THE quantile definition shared by histogram
+    windows, ``ServeMetrics``, and the request tracer (one definition,
+    or the /stats p99 and the profile artifact's p99 would drift).
+    Callers own sorting and the empty case."""
+    rank = max(0, min(len(ordered) - 1,
+                      round(q / 100.0 * (len(ordered) - 1))))
+    return ordered[int(rank)]
+
+
 def _format_value(v: float) -> str:
     """Prometheus sample value: integral floats render as integers
     (counters read naturally), everything else as repr(float)."""
@@ -158,10 +169,7 @@ class _HistogramChild:
             window = list(self._window)
         if not window:
             return None
-        ordered = sorted(window)
-        rank = max(0, min(len(ordered) - 1,
-                          round(q / 100.0 * (len(ordered) - 1))))
-        return ordered[int(rank)]
+        return nearest_rank(sorted(window), q)
 
 
 class Family:
@@ -473,6 +481,96 @@ def _split_labels(labels: str) -> Iterable[str]:
     if cur:
         out.append("".join(cur))
     return out
+
+
+# -- fleet-pane exposition merge (the elastic serve supervisor) -------------
+
+def merge_expositions(primary: str, workers: Dict[str, str],
+                      label_name: str = "worker") -> str:
+    """One Prometheus exposition from a primary process's text plus N
+    scraped worker texts, each worker's samples re-labeled with
+    ``label_name="<worker>"`` — the elastic serve supervisor's fleet
+    pane: one scrape target for the whole shared-nothing fleet, every
+    family emitted ONCE (``# TYPE`` twice is a format violation) with
+    the supervisor's own unlabeled samples alongside the worker-labeled
+    ones.
+
+    Worker texts that fail to parse are skipped whole (a scrape that
+    raced a dying worker must not poison the merged pane); the primary
+    text is trusted (it comes from :meth:`MetricsRegistry.expose`).
+    """
+    def _parse(text: str, worker: Optional[str]):
+        """(family, kind, help, sample) tuples; raises on any malformed
+        line so a torn worker scrape is rejected WHOLE."""
+        seen_types: Dict[str, str] = {}
+        out = []
+        for line in text.splitlines():
+            if not line:
+                continue
+            m = _HELP_RE.match(line)
+            if m:
+                out.append((m.group(1), None, m.group(2), None))
+                continue
+            m = _TYPE_RE.match(line)
+            if m:
+                seen_types[m.group(1)] = m.group(2)
+                out.append((m.group(1), m.group(2), None, None))
+                continue
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                raise ValueError(f"malformed sample line: {line!r}")
+            name = m.group("name")
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)] if name.endswith(suffix) else None
+                if base and base in seen_types:
+                    family = base
+                    break
+            if worker is None:
+                sample = line
+            else:
+                labels = m.group("labels")
+                tag = f'{label_name}="{_escape_label_value(worker)}"'
+                body = f"{tag},{labels}" if labels else tag
+                sample = f"{name}{{{body}}} {m.group('value')}"
+            out.append((family, None, None, sample))
+        return out
+
+    families: Dict[str, Dict[str, object]] = {}
+    order: List[str] = []
+
+    def _commit(parsed) -> None:
+        for family, kind, help_text, sample in parsed:
+            fam = families.setdefault(
+                family, {"help": None, "type": None, "samples": []}
+            )
+            if family not in order:
+                order.append(family)
+            if help_text is not None and fam["help"] is None:
+                fam["help"] = help_text
+            if kind is not None and fam["type"] is None:
+                fam["type"] = kind
+            if sample is not None:
+                fam["samples"].append(sample)  # type: ignore[union-attr]
+
+    _commit(_parse(primary, None))
+    for worker, text in sorted(workers.items()):
+        try:
+            parsed = _parse(text, worker)
+        except ValueError:
+            # torn scrape (worker died mid-write): drop this worker's
+            # contribution whole, keep the pane serving
+            continue
+        _commit(parsed)
+    lines: List[str] = []
+    for name in order:
+        fam = families[name]
+        if fam["help"] is not None:
+            lines.append(f"# HELP {name} {fam['help']}")
+        if fam["type"] is not None:
+            lines.append(f"# TYPE {name} {fam['type']}")
+        lines.extend(fam["samples"])  # type: ignore[arg-type]
+    return "\n".join(lines) + "\n"
 
 
 #: The process-wide registry every subsystem records into.

@@ -1,0 +1,712 @@
+"""Request-scoped tracing: one span ledger per served request, with
+derived per-phase tail-latency attribution.
+
+The serve tier (serve/) can say *that* a p99 request was slow but not
+*why* — queue wait under a burst? pad-heavy bucket? a wedged replica
+stalling the dispatch loop? a drain pool ceiling? Dapper-style
+request-scoped tracing is the standard answer: an id is assigned at
+ingress (W3C ``traceparent`` accepted, ``X-Request-Id`` echoed), every
+lifecycle transition stamps a timestamp onto the request, and the
+completion drain derives a contiguous span ledger whose durations sum
+to the request's end-to-end latency *by construction*:
+
+    ========== ===================================================
+    span       boundary (consecutive lifecycle marks)
+    ========== ===================================================
+    decode     ingress → admitted (decode/preprocess + admission)
+    queue_wait admitted → flushed (batching wait; tagged with the
+               flush reason: full/deadline/eager/shed)
+    placement  flushed → placed (slot-claim backpressure + stack/pad
+               + H2D on the placement worker)
+    dispatch_wait placed → dispatched (buffered behind the dispatch
+               loop — a wedged replica/predecessor shows up HERE)
+    device_exec dispatched → device result on host (the honest
+               host-observed service time per bucket)
+    drain      device result → future resolved (slice/threshold/
+               per-request fan-out)
+    ========== ===================================================
+
+On top of the ledger this module derives the aggregate views:
+
+* **per-phase attribution** (``snapshot_attribution``): p50/p95/p99 per
+  span over a bounded ring of completed ledgers — the ``/stats``
+  ``attribution`` block;
+* **SLO burn-rate gauges**: rolling error-budget burn over a fast and a
+  slow window (the Google-SRE multi-window pattern; burn 1.0 = spending
+  exactly the budget, >1 = on track to exhaust it);
+* **slow-request structured log**: any request above the threshold logs
+  ONE JSON line with its id and full ledger (and lands in the flight
+  ring), so the p99 tail is attributable post-hoc without a debugger;
+* **per-bucket service-time profiles**: device-exec histograms +
+  pad-ratio + flush-reason mix per bucket size, persisted as a
+  versioned ``dpt_serve_profile`` v1 artifact — the calibration input
+  the ROADMAP's ``plan-serve`` discrete-event capacity planner needs
+  (measured service times per bucket are exactly what a queue
+  simulation replays arrival traces against).
+
+Hot-path contract (dptlint ``obs-hot-path``, like the rest of ``obs/``):
+``mark_*`` calls on the dispatch path are attribute/dict assignments
+only; ``record_*``/``complete`` run on completion workers (the
+sanctioned drain context) and append only to bounded rings. ``DPT_OBS=0``
+disables request tracing entirely (the overhead A/B lever —
+docs/OBSERVABILITY.md states the measured delta).
+
+Stdlib-only and jax-free, like the rest of ``obs/``.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributedpytorch_tpu.obs.registry import nearest_rank
+
+logger = logging.getLogger(__name__)
+
+#: Artifact identity (the planner-file idiom, analysis/planner.py):
+#: consumers refuse anything else — a stale or foreign file must never
+#: silently calibrate a capacity plan.
+PROFILE_KIND = "dpt_serve_profile"
+PROFILE_VERSION = 1
+
+#: Lifecycle marks, in order. A span is the gap between two consecutive
+#: PRESENT marks, named after the LATER mark's phase (table below) — so
+#: the ledger is contiguous and its durations sum to resolved − ingress
+#: exactly, whatever subset of marks a request collected.
+EVENTS = ("ingress", "enqueued", "flushed", "placed", "dispatched",
+          "device_done", "resolved")
+
+#: Span name for the gap that ENDS at each mark.
+PHASE_FOR_EVENT = {
+    "enqueued": "decode",
+    "flushed": "queue_wait",
+    "placed": "placement",
+    "dispatched": "dispatch_wait",
+    "device_done": "device_exec",
+    "resolved": "drain",
+}
+
+PHASES = ("decode", "queue_wait", "placement", "dispatch_wait",
+          "device_exec", "drain")
+
+#: Device-exec histogram ladder for the per-bucket profiles: serving
+#: service times live well under the generic registry ladder's tail.
+SERVICE_TIME_BOUNDS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0,
+)
+
+_TRACEPARENT_RE = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$"
+)
+#: Accepted shape for a client-supplied ``X-Request-Id``: the id is
+#: echoed back as a response HEADER and written into grep-able logs and
+#: flight-ring records, so anything outside this charset (CR/LF above
+#: all — header injection) is refused and a server-assigned id used.
+_REQUEST_ID_RE = re.compile(r"^[A-Za-z0-9._:-]{1,128}$")
+
+_REQ_SEQ = itertools.count()
+#: Per-process id prefix so ids stay unique across a fleet of workers
+#: (two workers' counters would otherwise collide in one merged pane).
+_REQ_PREFIX = f"{os.getpid():x}-{int(time.time() * 1e3) & 0xFFFFFF:x}"
+
+
+def _obs_enabled() -> bool:
+    return os.environ.get("DPT_OBS", "1").lower() not in ("0", "off", "false")
+
+
+def new_request_id() -> str:
+    """A fleet-unique request id: process prefix + per-process counter
+    (no RNG on the ingress path; ids only need uniqueness, not
+    unpredictability)."""
+    return f"{_REQ_PREFIX}-{next(_REQ_SEQ):06x}"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[str]:
+    """The trace-id of a W3C ``traceparent`` header
+    (``00-<32hex>-<16hex>-<2hex>``), or None when absent/malformed —
+    a bad header must not reject the request, only lose the caller's
+    correlation."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    return m.group(1) if m else None
+
+
+def request_id_from_headers(headers) -> Optional[str]:
+    """Ingress id resolution: an inbound W3C ``traceparent`` trace-id
+    wins (cross-service correlation), else an explicit ``X-Request-Id``
+    — accepted only when it matches the safe-id charset (the id is
+    echoed back as a response header and logged verbatim; a CR/LF or
+    control character would be header/log injection) — else None (the
+    server assigns). ``headers`` is any .get-able."""
+    rid = parse_traceparent(headers.get("traceparent"))
+    if rid:
+        return rid
+    rid = headers.get("X-Request-Id")
+    if rid:
+        rid = str(rid).strip()
+        if not _REQUEST_ID_RE.match(rid):
+            return None
+    return rid or None
+
+
+class RequestTrace:
+    """One request's span ledger: lifecycle marks stamped by the serve
+    pipeline (attribute/dict assignment only — safe on the dispatch hot
+    path), spans derived at completion. All timestamps come from the
+    server's injectable clock, so fake-clock tests pin attribution
+    deterministically."""
+
+    __slots__ = ("request_id", "marks", "flush_reason", "bucket", "status")
+
+    def __init__(self, request_id: str, t_ingress: float):
+        self.request_id = request_id
+        self.marks: Dict[str, float] = {"ingress": float(t_ingress)}
+        self.flush_reason: Optional[str] = None
+        self.bucket: Optional[int] = None
+        self.status: Optional[str] = None
+
+    # -- lifecycle marks (hot-path safe: assignments only) -------------------
+    def mark(self, event: str, t: float) -> None:
+        self.marks[event] = float(t)
+
+    def mark_flushed(self, t: float, reason: str, bucket: int) -> None:
+        self.marks["flushed"] = float(t)
+        self.flush_reason = reason
+        self.bucket = int(bucket)
+
+    # -- derivation ----------------------------------------------------------
+    def spans(self) -> Dict[str, float]:
+        """Contiguous per-phase durations (seconds). Present marks only;
+        sums to ``resolved − ingress`` exactly when both exist."""
+        out: Dict[str, float] = {}
+        prev_t = self.marks.get("ingress")
+        if prev_t is None:
+            return out
+        for event in EVENTS[1:]:
+            t = self.marks.get(event)
+            if t is None:
+                continue
+            out[PHASE_FOR_EVENT[event]] = max(0.0, t - prev_t)
+            prev_t = t
+        return out
+
+    def latency_s(self) -> Optional[float]:
+        t0 = self.marks.get("ingress")
+        t1 = self.marks.get("resolved")
+        if t0 is None or t1 is None:
+            return None
+        return max(0.0, t1 - t0)
+
+    def ledger(self, spans: Optional[Dict[str, float]] = None,
+               latency_s: Optional[float] = None) -> dict:
+        """The completed-request record the ring keeps (and the slow-
+        request log emits): id, status, flush provenance, span ms.
+        ``spans``/``latency_s`` accept precomputed values so the
+        completion path derives them exactly once."""
+        spans = self.spans() if spans is None else spans
+        lat = self.latency_s() if latency_s is None else latency_s
+        return {
+            "request_id": self.request_id,
+            "status": self.status,
+            "flush": self.flush_reason,
+            "bucket": self.bucket,
+            "latency_ms": round(lat * 1e3, 3) if lat is not None else None,
+            "spans_ms": {k: round(v * 1e3, 3) for k, v in spans.items()},
+        }
+
+
+class _BurnWindow:
+    """O(1) rolling good/bad counts over the last ``window_s`` seconds:
+    one ring bucket per second, expired buckets zeroed as the clock
+    advances — no per-request allocation, fake-clock friendly (every
+    timestamp is passed in)."""
+
+    __slots__ = ("window_s", "_good", "_bad", "_sec", "good", "bad")
+
+    def __init__(self, window_s: float):
+        n = max(1, int(window_s))
+        self.window_s = float(n)
+        self._good = [0] * n
+        self._bad = [0] * n
+        self._sec: Optional[int] = None  # current second, or None
+        self.good = 0
+        self.bad = 0
+
+    def _advance(self, t: float) -> None:
+        sec = int(t)
+        n = len(self._good)
+        if self._sec is None:
+            self._sec = sec
+            return
+        if sec <= self._sec:
+            return  # same second (or a fake clock standing still)
+        steps = min(sec - self._sec, n)
+        for k in range(1, steps + 1):
+            i = (self._sec + k) % n
+            self.good -= self._good[i]
+            self.bad -= self._bad[i]
+            self._good[i] = 0
+            self._bad[i] = 0
+        self._sec = sec
+
+    def add(self, t: float, bad: bool) -> None:
+        self._advance(t)
+        i = int(t) % len(self._good)
+        if bad:
+            self._bad[i] += 1
+            self.bad += 1
+        else:
+            self._good[i] += 1
+            self.good += 1
+
+    def error_fraction(self, t: float) -> Optional[float]:
+        self._advance(t)
+        total = self.good + self.bad
+        if total == 0:
+            return None
+        return self.bad / total
+
+
+class _BucketProfile:
+    """Per-bucket service-time accumulator: exact cumulative device-exec
+    histogram + pad accounting + flush-reason mix, plus a bounded
+    quantile window (the registry-histogram discipline)."""
+
+    __slots__ = ("bounds", "counts", "sum_s", "count", "window",
+                 "real_rows", "pad_rows", "flush_reasons")
+
+    def __init__(self, window: int = 512):
+        self.bounds = SERVICE_TIME_BOUNDS
+        self.counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self.sum_s = 0.0
+        self.count = 0
+        self.window: collections.deque = collections.deque(maxlen=window)
+        self.real_rows = 0
+        self.pad_rows = 0
+        # bounded by construction: the four flush regimes
+        self.flush_reasons: Dict[str, int] = {}
+
+    def record(self, device_exec_s: float, bucket: int, real_rows: int,
+               flush_reason: Optional[str]) -> None:
+        v = float(device_exec_s)
+        i = 0
+        for i, bound in enumerate(self.bounds):  # noqa: B007 — tiny ladder
+            if v <= bound:
+                break
+        else:
+            i = len(self.bounds)
+        self.counts[i] += 1
+        self.sum_s += v
+        self.count += 1
+        self.window.append(v)
+        self.real_rows += int(real_rows)
+        self.pad_rows += max(0, int(bucket) - int(real_rows))
+        if flush_reason:
+            self.flush_reasons[flush_reason] = (
+                self.flush_reasons.get(flush_reason, 0) + 1
+            )
+
+    def _quantile(self, q: float) -> Optional[float]:
+        window = sorted(self.window)
+        if not window:
+            return None
+        return nearest_rank(window, q)
+
+    def payload(self) -> dict:
+        dispatched = self.real_rows + self.pad_rows
+        cumulative: List[List[float]] = []
+        running = 0
+        for bound, c in zip(self.bounds, self.counts[:-1]):
+            running += c
+            cumulative.append([bound, running])
+        cumulative.append(["+Inf", running + self.counts[-1]])
+        return {
+            "dispatches": self.count,
+            "device_exec_s": {
+                "count": self.count,
+                "sum": round(self.sum_s, 6),
+                "mean": round(self.sum_s / self.count, 6) if self.count else None,
+                "p50": self._quantile(50),
+                "p99": self._quantile(99),
+                "cumulative_buckets": cumulative,
+            },
+            "real_rows": self.real_rows,
+            "pad_rows": self.pad_rows,
+            "pad_ratio": (
+                round(self.pad_rows / dispatched, 4) if dispatched else 0.0
+            ),
+            "flush_reasons": dict(sorted(self.flush_reasons.items())),
+        }
+
+
+def _percentile(values: List[float], q: float) -> Optional[float]:
+    return nearest_rank(sorted(values), q) if values else None
+
+
+class ReqTracer:
+    """Per-server request-trace aggregator (the ``ServeMetrics`` shape:
+    one per Server object, recording from completion workers and the
+    ingress path — never the dispatch loop).
+
+    ``latency_slo_s`` is the end-to-end "good request" bound the burn
+    windows judge against (default 2× the batching SLO: the batching
+    wait plus a comparable service allowance); ``slow_s`` is the
+    structured-log threshold (default 2× ``latency_slo_s``).
+    ``slo_target`` is the availability objective — burn rate =
+    error_fraction / (1 − slo_target).
+    """
+
+    def __init__(
+        self,
+        slo_s: float = 0.05,
+        latency_slo_s: Optional[float] = None,
+        slow_s: Optional[float] = None,
+        slo_target: float = 0.99,
+        clock: Callable[[], float] = time.monotonic,
+        window: int = 2048,
+        fast_window_s: float = 60.0,
+        slow_window_s: float = 600.0,
+        timeline=None,
+    ):
+        self.enabled = _obs_enabled()
+        # label children resolved ONCE (a .labels() lookup per phase per
+        # request would dominate the record cost at serving rates)
+        if self.enabled:
+            from distributedpytorch_tpu.obs import defs as obsm
+
+            self._phase_obs = {
+                p: obsm.SERVE_PHASE_SECONDS.labels(phase=p) for p in PHASES
+            }
+            self._burn_fast_gauge = obsm.SERVE_SLO_BURN_FAST
+            self._burn_slow_gauge = obsm.SERVE_SLO_BURN_SLOW
+            self._slow_counter = obsm.SERVE_SLOW_REQUESTS
+            self._exec_obs: Dict[int, object] = {}
+        self.slo_s = float(slo_s)
+        self.latency_slo_s = (
+            float(latency_slo_s) if latency_slo_s is not None
+            else 2.0 * self.slo_s
+        )
+        self.slow_s = (
+            float(slow_s) if slow_s is not None else 2.0 * self.latency_slo_s
+        )
+        self.slo_target = min(max(float(slo_target), 0.0), 0.9999)
+        self.clock = clock
+        self.timeline = timeline  # utils/trace.StepTimeline or None
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=window)
+        self._slow = 0
+        self._completed = 0
+        self._fast = _BurnWindow(fast_window_s)
+        self._slow_win = _BurnWindow(slow_window_s)
+        self._profiles: Dict[int, _BucketProfile] = {}
+
+    # -- ingress -------------------------------------------------------------
+    def begin(self, request_id: Optional[str] = None,
+              t: Optional[float] = None) -> Optional[RequestTrace]:
+        """A new per-request trace, or None when tracing is disabled
+        (``DPT_OBS=0``) — every downstream mark site guards on None."""
+        if not self.enabled:
+            return None
+        return RequestTrace(
+            request_id or new_request_id(),
+            self.clock() if t is None else t,
+        )
+
+    # -- completion (completion workers / ingress rejection paths) -----------
+    def complete(self, trace: Optional[RequestTrace], status: str,
+                 t: Optional[float] = None) -> None:
+        """Close a trace: derive its ledger, feed the attribution ring,
+        the burn windows, the per-phase registry histograms, the slow-
+        request log, and (when armed) the timeline JSONL."""
+        if trace is None or not self.enabled:
+            return
+        now = self.clock() if t is None else t
+        if "resolved" not in trace.marks:
+            trace.mark("resolved", now)
+        trace.status = status
+        spans = trace.spans()
+        latency = trace.latency_s() or 0.0
+        served = status == "ok"
+        bad = status in ("error", "rejected") or (
+            served and latency > self.latency_slo_s
+        )
+        slow = served and latency >= self.slow_s
+        if not served and "device_done" not in trace.marks:
+            # an unserved request's trailing gap (ingress/admit →
+            # rejection/error resolve) must not masquerade as a `drain`
+            # span — a shed storm would read as a slice/threshold
+            # bottleneck in the ring and on the timeline
+            if "drain" in spans:
+                spans["unserved"] = spans.pop("drain")
+        ledger = trace.ledger(spans=spans, latency_s=latency)
+        with self._lock:
+            self._ring.append(ledger)
+            self._completed += 1
+            if slow:
+                self._slow += 1
+            self._fast.add(now, bad)
+            self._slow_win.add(now, bad)
+            budget = 1.0 - self.slo_target
+            fast_frac = self._fast.error_fraction(now)
+            slow_frac = self._slow_win.error_fraction(now)
+        if served:
+            phase_obs = self._phase_obs
+            for phase, dur in spans.items():
+                phase_obs[phase].observe(dur)
+        if fast_frac is not None:
+            self._burn_fast_gauge.set(fast_frac / budget)
+        if slow_frac is not None:
+            self._burn_slow_gauge.set(slow_frac / budget)
+        if slow:
+            self._slow_counter.inc()
+            # ONE structured line per slow request: grep-able, and the
+            # flight ring keeps the tail for post-mortems
+            logger.warning("slow request: %s", json.dumps(ledger))
+            from distributedpytorch_tpu.obs import flight
+
+            flight.record("slow_request", **ledger)
+        if served:
+            # only served requests export phase spans to the timeline:
+            # a shed's pseudo-span on the Perfetto pane would point the
+            # post-mortem at the wrong phase (its story is the
+            # request_reject flight record instead)
+            self._export_spans(trace)
+
+    def _export_spans(self, trace: RequestTrace) -> None:
+        """Feed the armed timeline (Perfetto via obs/trace_hub.py): one
+        span per phase, wall-anchored backwards from now so phases of
+        one request line up contiguously on the fleet timeline."""
+        timeline = self.timeline
+        if timeline is None or not trace.marks.get("resolved"):
+            return
+        wall_now = time.time()
+        t_res = trace.marks["resolved"]
+        prev = trace.marks.get("ingress")
+        for event in EVENTS[1:]:
+            t = trace.marks.get(event)
+            if t is None or prev is None:
+                continue
+            timeline.record(
+                PHASE_FOR_EVENT[event], prev, t,
+                wall=wall_now - (t_res - t),
+                request_id=trace.request_id,
+                **({"flush": trace.flush_reason, "bucket": trace.bucket}
+                   if event == "flushed" else {}),
+            )
+            prev = t
+
+    def reject(self, trace: Optional[RequestTrace], reason: str,
+               request_id: str = "", t: Optional[float] = None,
+               **fields) -> None:
+        """A shed/rejected request: stamp id + reason into the flight
+        ring (the post-mortem can then name WHICH requests were shed and
+        why — counters alone cannot) and burn error budget."""
+        from distributedpytorch_tpu.obs import flight
+
+        rid = trace.request_id if trace is not None else request_id
+        flight.record("request_reject", request_id=rid, reason=reason,
+                      **fields)
+        self.complete(trace, "rejected", t=t)
+
+    def record_dispatch(self, bucket: int, real_rows: int,
+                        device_exec_s: float,
+                        flush_reason: Optional[str]) -> None:
+        """One dispatched group's service-time observation (called from
+        the completion drain, once per bucket execution)."""
+        if not self.enabled:
+            return
+        b = int(bucket)
+        with self._lock:
+            prof = self._profiles.get(b)
+            if prof is None:
+                # bounded by construction: one entry per ladder bucket
+                prof = self._profiles[b] = _BucketProfile()
+            prof.record(device_exec_s, b, real_rows, flush_reason)
+        child = self._exec_obs.get(b)
+        if child is None:
+            from distributedpytorch_tpu.obs import defs as obsm
+
+            # setdefault: _exec_obs is read OUTSIDE the lock, so a racing
+            # first dispatch on this bucket must not drop a child
+            child = self._exec_obs.setdefault(
+                b, obsm.SERVE_DEVICE_EXEC.labels(bucket=str(b))
+            )
+        child.observe(float(device_exec_s))
+
+    def refresh_burn_gauges(self, t: Optional[float] = None) -> None:
+        """Re-derive the burn gauges from the CURRENT window contents.
+        ``complete()`` updates them per request, which means they would
+        freeze at the last computed value once traffic stops (an error
+        burst's 5.0 burn would page forever after the LB drains the
+        worker) — the serve front calls this on every ``/metrics`` and
+        ``/stats`` read so scraped values decay with the windows."""
+        if not self.enabled:
+            return
+        now = self.clock() if t is None else t
+        with self._lock:
+            budget = 1.0 - self.slo_target
+            fast_frac = self._fast.error_fraction(now)
+            slow_frac = self._slow_win.error_fraction(now)
+        # an EMPTY window reads burn 0 (nothing erring now), not stale
+        self._burn_fast_gauge.set(
+            fast_frac / budget if fast_frac is not None else 0.0
+        )
+        self._burn_slow_gauge.set(
+            slow_frac / budget if slow_frac is not None else 0.0
+        )
+
+    # -- aggregation (pull-based) -------------------------------------------
+    def recent(self, limit: Optional[int] = None) -> List[dict]:
+        """The newest completed ledgers (oldest→newest) — the exemplar
+        lookup path: given a p99 exemplar id from ``/stats``, find its
+        full span ledger here (or in the slow-request log)."""
+        with self._lock:
+            ledgers = list(self._ring)
+        return ledgers if limit is None else ledgers[-int(limit):]
+
+    def snapshot_attribution(self, exemplars: Optional[List[str]] = None,
+                             t: Optional[float] = None) -> dict:
+        """The ``/stats`` ``attribution`` block: per-phase percentiles
+        over the completed ring (served requests only), slow-request
+        count, burn-rate state, and the p99 window's exemplar trace ids
+        (computed by ServeMetrics over its latency window and passed
+        in — one latency story, not two)."""
+        now = self.clock() if t is None else t
+        with self._lock:
+            ledgers = [d for d in self._ring if d.get("status") == "ok"]
+            slow = self._slow
+            completed = self._completed
+            budget = 1.0 - self.slo_target
+            fast_frac = self._fast.error_fraction(now)
+            slow_frac = self._slow_win.error_fraction(now)
+        if self.enabled:
+            # keep the gauges in step with this (decayed) view — /stats
+            # and /metrics must tell one burn story
+            self._burn_fast_gauge.set(
+                fast_frac / budget if fast_frac is not None else 0.0
+            )
+            self._burn_slow_gauge.set(
+                slow_frac / budget if slow_frac is not None else 0.0
+            )
+        per_phase: Dict[str, List[float]] = {p: [] for p in PHASES}
+        for d in ledgers:
+            for phase, ms in d.get("spans_ms", {}).items():
+                if phase in per_phase:
+                    per_phase[phase].append(ms)
+        phases = {}
+        for phase in PHASES:
+            vals = per_phase[phase]
+            phases[phase] = (
+                None if not vals else {
+                    "count": len(vals),
+                    "p50_ms": round(_percentile(vals, 50), 3),
+                    "p95_ms": round(_percentile(vals, 95), 3),
+                    "p99_ms": round(_percentile(vals, 99), 3),
+                }
+            )
+        return {
+            "phases": phases,
+            "completed": completed,
+            "slow_requests": slow,
+            "slow_threshold_ms": round(self.slow_s * 1e3, 3),
+            "p99_exemplars": list(exemplars or []),
+            "slo_burn": {
+                "target": self.slo_target,
+                "latency_slo_ms": round(self.latency_slo_s * 1e3, 3),
+                "fast_window_s": self._fast.window_s,
+                "slow_window_s": self._slow_win.window_s,
+                "fast": (
+                    round(fast_frac / budget, 4)
+                    if fast_frac is not None else None
+                ),
+                "slow": (
+                    round(slow_frac / budget, 4)
+                    if slow_frac is not None else None
+                ),
+            },
+        }
+
+    def phase_medians_ms(self) -> Dict[str, Optional[float]]:
+        """Per-phase p50s in ms (bench_serve's per-leg calibration row)."""
+        snap = self.snapshot_attribution()
+        return {
+            phase: (info["p50_ms"] if info else None)
+            for phase, info in snap["phases"].items()
+        }
+
+    def profile_payload(
+        self, phase_medians_ms: Optional[Dict[str, Optional[float]]] = None,
+        **meta,
+    ) -> dict:
+        """The ``dpt_serve_profile`` v1 payload: per-bucket service-time
+        profiles + the phase medians, stamped with whatever run metadata
+        the caller provides (geometry, replicas, SLO). Pass
+        ``phase_medians_ms`` when the caller already snapshotted them
+        (bench_serve's per-leg row) — one consistent snapshot in the
+        row and the artifact, and no second O(ring) aggregation."""
+        with self._lock:
+            buckets = {
+                str(b): prof.payload()
+                for b, prof in sorted(self._profiles.items())
+            }
+        return {
+            "kind": PROFILE_KIND,
+            "version": PROFILE_VERSION,
+            "created_unix": round(time.time(), 3),
+            "slo_ms": round(self.slo_s * 1e3, 3),
+            "latency_slo_ms": round(self.latency_slo_s * 1e3, 3),
+            "phase_medians_ms": (
+                phase_medians_ms if phase_medians_ms is not None
+                else self.phase_medians_ms()
+            ),
+            "buckets": buckets,
+            **meta,
+        }
+
+
+# -- profile-artifact IO (the planner-file idiom; jax-free) ------------------
+def save_profile(payload: dict, path: str) -> str:
+    """Atomic write of a ``dpt_serve_profile`` payload; returns ``path``."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, path)
+    return path
+
+
+def load_profile(path: Optional[str]) -> Optional[dict]:
+    """The profile, or None (with a logged note) for missing / corrupt /
+    version-skewed files — consumers (the ``plan-serve`` capacity
+    planner) degrade to uncalibrated defaults on None; a torn or stale
+    artifact must never silently calibrate a plan."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError) as exc:
+        logger.warning("serve profile %r unreadable (%s) — ignored",
+                       path, type(exc).__name__)
+        return None
+    if (
+        not isinstance(payload, dict)
+        or payload.get("kind") != PROFILE_KIND
+        or payload.get("version") != PROFILE_VERSION
+        or not isinstance(payload.get("buckets"), dict)
+    ):
+        logger.warning(
+            "serve profile %r is not a %s v%d artifact — ignored (stale "
+            "or foreign file)", path, PROFILE_KIND, PROFILE_VERSION,
+        )
+        return None
+    return payload

@@ -118,14 +118,14 @@ def trace_events_from_spans(
     return out
 
 
-def _metadata_events(ranks: Sequence[int],
-                     phases: Sequence[str]) -> List[dict]:
+def _metadata_events(ranks: Sequence[int], phases: Sequence[str],
+                     process_label: str = "rank") -> List[dict]:
     meta: List[dict] = []
     extra_tids: Dict[str, int] = {}
     for rank in sorted(set(ranks)):
         meta.append({
             "ph": "M", "name": "process_name", "pid": rank, "tid": 0,
-            "args": {"name": f"rank {rank}"},
+            "args": {"name": f"{process_label} {rank}"},
         })
         for phase in phases:
             meta.append({
@@ -136,10 +136,13 @@ def _metadata_events(ranks: Sequence[int],
     return meta
 
 
-def build_trace(spans_by_rank: Dict[int, List[dict]]) -> dict:
+def build_trace(spans_by_rank: Dict[int, List[dict]],
+                process_label: str = "rank") -> dict:
     """One Perfetto-loadable trace from per-rank span lists, events
     sorted by timestamp (Perfetto tolerates unsorted input; humans
-    diffing the JSON do not)."""
+    diffing the JSON do not). ``process_label`` names the per-process
+    tracks — "rank" for training jobs, "worker" for the serve fleet's
+    merged pane."""
     events: List[dict] = []
     phases: List[str] = []
     for rank, spans in sorted(spans_by_rank.items()):
@@ -149,7 +152,8 @@ def build_trace(spans_by_rank: Dict[int, List[dict]]) -> dict:
                 phases.append(p)
         events.extend(trace_events_from_spans(spans, default_rank=rank))
     events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"]))
-    meta = _metadata_events(list(spans_by_rank), phases)
+    meta = _metadata_events(list(spans_by_rank), phases,
+                            process_label=process_label)
     return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
@@ -169,6 +173,7 @@ def timeline_rank_paths(base_path: str) -> List[Tuple[int, str]]:
 
 def merge_timelines(
     paths: Union[str, Sequence[Union[str, Tuple[int, str]]]],
+    process_label: str = "rank",
 ) -> dict:
     """Merge timeline JSONL files into one trace. ``paths`` may be a
     base path (rank files discovered via :func:`timeline_rank_paths`),
@@ -191,18 +196,19 @@ def merge_timelines(
         for e in events:
             r = int(e.get("rank", rank))
             by_rank.setdefault(r, []).append(e)
-    return build_trace(by_rank)
+    return build_trace(by_rank, process_label=process_label)
 
 
 def write_merged_trace(
     paths: Union[str, Sequence[Union[str, Tuple[int, str]]]],
     out_path: str,
+    process_label: str = "rank",
 ) -> Optional[str]:
     """Merge + write; returns ``out_path``, or None when no events were
     found (no empty artifacts). Never raises — callers are teardown
     paths (the elastic supervisor's report step)."""
     try:
-        trace = merge_timelines(paths)
+        trace = merge_timelines(paths, process_label=process_label)
         if not any(e["ph"] == "X" for e in trace["traceEvents"]):
             return None
         os.makedirs(os.path.dirname(os.path.abspath(out_path)),

@@ -62,6 +62,7 @@ class PredictionCache:
         self.used_bytes = 0
         self.hits = 0
         self.misses = 0
+        self.bypasses = 0
 
     @staticmethod
     def _nbytes(masks: List[np.ndarray]) -> int:
@@ -97,6 +98,15 @@ class PredictionCache:
                 self.used_bytes -= self._nbytes(evicted)
         return True
 
+    def record_bypass(self) -> None:
+        """A lookup skipped because replica groups serve mixed weight
+        versions (rollout canary in flight): counted so the fleet pane
+        can attribute a hit-rate dip — and a shed burst — to the bypass
+        window instead of guessing."""
+        with self._lock:
+            self.bypasses += 1
+        obsm.SERVE_PREDICT_CACHE.labels(result="bypass").inc()
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._items)
@@ -106,6 +116,7 @@ class PredictionCache:
             return {
                 "hits": self.hits,
                 "misses": self.misses,
+                "bypasses": self.bypasses,
                 "entries": len(self._items),
                 "bytes": self.used_bytes,
             }

@@ -10,7 +10,11 @@ layer exists for. Endpoints:
 * ``POST /predict`` — body: one image (any PIL-decodable format) →
   ``image/png`` mask ({0, 255}); ``503`` + JSON (with a ``Retry-After``
   header) when shed or mid-relaunch (body carries the rejection
-  reason), ``400`` on an undecodable body.
+  reason), ``400`` on an undecodable body. Request-scoped tracing
+  (obs/reqtrace.py): a W3C ``traceparent`` header's trace-id is
+  adopted, else an id is assigned at ingress; EVERY answer echoes it
+  as ``X-Request-Id``, and its span ledger is attributable via
+  ``/stats`` exemplars, the slow-request log, and the flight ring.
 * ``GET /healthz``  — **readiness**: 200 + the compiled bucket/replica
   inventory, ``uptime_s``, ``weights_version``, and the build/config
   fingerprint while serving; **503 + ``ready: false``** while the
@@ -153,6 +157,20 @@ def get_args(argv=None):
                         help="Cadence of the replica-count "
                              "recommendation (gauge + log line; "
                              "recommendation only). 0 = off")
+    parser.add_argument("--latency-slo-ms", type=float, default=None,
+                        help="End-to-end good-request latency bound for "
+                             "the SLO burn-rate gauges (default 2x "
+                             "--slo-ms)")
+    parser.add_argument("--slow-request-ms", type=float, default=0.0,
+                        help="Structured-log threshold: served requests "
+                             "slower than this log one JSON line with "
+                             "their id + span ledger (<= 0 = 2x the "
+                             "latency SLO)")
+    parser.add_argument("--trace-timeline", type=str, default=None,
+                        metavar="PATH",
+                        help="Append per-request span JSONL here (rank R "
+                             "writes PATH.rankR under a supervisor); "
+                             "merge to Perfetto via obs/trace_hub.py")
     parser.add_argument("--heartbeat-dir", type=str, default=None,
                         help="Write per-rank beat files here for the "
                              "elastic supervisor (normally armed by "
@@ -204,6 +222,9 @@ def to_config(args):
         watch_checkpoint=args.watch_checkpoint,
         watch_poll_s=args.watch_poll,
         autoscale_interval_s=args.autoscale_interval,
+        latency_slo_ms=args.latency_slo_ms,
+        slow_request_ms=args.slow_request_ms,
+        trace_timeline=args.trace_timeline,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_interval_s=args.heartbeat_interval,
         inject_faults=tuple(args.inject_fault),
@@ -215,7 +236,11 @@ def to_config(args):
 def build_server(args):
     """args → started-able :class:`Server` (engine AOT-compiles here),
     with the fleet components attached: rollout manager (+ optional
-    checkpoint watcher), autoscale hint, armed chaos faults."""
+    checkpoint watcher), autoscale hint, armed chaos faults, and — when
+    ``--trace-timeline`` is set — the per-request span JSONL (rank R of
+    a supervised fleet appends ``.rankR``, the trace-hub convention)."""
+    import os
+
     from distributedpytorch_tpu.serve.server import Server
 
     cfg = to_config(args)
@@ -223,7 +248,15 @@ def build_server(args):
         from distributedpytorch_tpu.utils import faults
 
         faults.install(cfg.inject_faults)
-    server = Server.from_config(cfg)
+    timeline = None
+    if cfg.trace_timeline:
+        from distributedpytorch_tpu.utils.trace import StepTimeline
+
+        rank = int(os.environ.get("RANK", "0"))
+        path = (cfg.trace_timeline if rank == 0
+                else f"{cfg.trace_timeline}.rank{rank}")
+        timeline = StepTimeline(path, rank=rank)
+    server = Server.from_config(cfg, timeline=timeline)
     attach_fleet(server, cfg)
     return server
 
@@ -281,6 +314,10 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
         healthz_payload,
         metrics_response,
     )
+    from distributedpytorch_tpu.obs.reqtrace import (
+        new_request_id,
+        request_id_from_headers,
+    )
     from distributedpytorch_tpu.serve.server import (
         STATUS_REJECTED,
         STATUS_SHUTDOWN,
@@ -293,7 +330,8 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
 
     class Handler(BaseHTTPRequestHandler):
         def _json(self, code: int, obj: dict,
-                  retry_after: Optional[int] = None) -> None:
+                  retry_after: Optional[int] = None,
+                  request_id: Optional[str] = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -302,6 +340,8 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                 # every 503 carries the back-off hint: "relaunching" and
                 # "overloaded" mean retry HERE after this many seconds
                 self.send_header("Retry-After", str(int(retry_after)))
+            if request_id:
+                self.send_header("X-Request-Id", request_id)
             self.end_headers()
             self.wfile.write(body)
 
@@ -335,6 +375,10 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                 else:
                     self._json(200, manager.status())
             elif self.path == "/metrics":
+                # burn gauges decay with their windows: re-derive at
+                # scrape time so a quiet worker's burn reads 0, not the
+                # last error burst's value frozen forever
+                server.tracer.refresh_burn_gauges()
                 body, ctype = metrics_response()
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
@@ -379,14 +423,21 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
+            # request-scoped tracing (obs/reqtrace.py): a W3C
+            # traceparent's trace-id (or an explicit X-Request-Id) is
+            # adopted for cross-service correlation, else one is
+            # assigned HERE — every answer, 4xx/5xx included, echoes it
+            rid = (request_id_from_headers(self.headers)
+                   or new_request_id())
             try:
                 img = Image.open(io.BytesIO(body))
                 img.load()
             except Exception:  # noqa: BLE001 — undecodable body → 400
-                self._json(400, {"error": "body is not a decodable image"})
+                self._json(400, {"error": "body is not a decodable image",
+                                 "request_id": rid}, request_id=rid)
                 return
             try:
-                response = server.submit(img).result(
+                response = server.submit(img, request_id=rid).result(
                     timeout=request_timeout_s
                 )
             except concurrent.futures.TimeoutError:
@@ -395,8 +446,10 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                 self._json(504, {
                     "status": "error",
                     "reason": f"no result within {request_timeout_s:.0f} s",
-                })
+                    "request_id": rid,
+                }, request_id=rid)
                 return
+            rid = response.request_id or rid
             if not response.ok:
                 # rejection/shutdown = "service unavailable, retry"
                 # (the reason says whether HERE or elsewhere); anything
@@ -405,10 +458,11 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                         in (STATUS_REJECTED, STATUS_SHUTDOWN) else 500)
                 self._json(code, {
                     "status": response.status, "reason": response.reason,
+                    "request_id": rid,
                 }, retry_after=(
                     server.retry_after_s(response.reason)
                     if code == 503 else None
-                ))
+                ), request_id=rid)
                 return
             buf = io.BytesIO()
             Image.fromarray(response.masks[0]).save(buf, format="PNG")
@@ -419,6 +473,7 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
             self.send_header(
                 "X-Serve-Latency-Ms", f"{response.latency_ms:.2f}"
             )
+            self.send_header("X-Request-Id", rid)
             self.end_headers()
             self.wfile.write(data)
 

@@ -32,13 +32,16 @@ from distributedpytorch_tpu.obs import defs as obsm
 
 
 def percentile(values: List[float], q: float) -> float:
-    """Nearest-rank percentile (q in [0, 100]) — no numpy dependency so
-    jax-free callers (the bench report path) stay jax-free."""
+    """Nearest-rank percentile (q in [0, 100]) — NaN on empty, no numpy
+    dependency so jax-free callers (the bench report path) stay
+    jax-free. The rank math is the shared obs definition
+    (``registry.nearest_rank``) so /stats and the profile artifact
+    cannot drift."""
+    from distributedpytorch_tpu.obs.registry import nearest_rank
+
     if not values:
         return float("nan")
-    ordered = sorted(values)
-    rank = max(0, min(len(ordered) - 1, round(q / 100.0 * (len(ordered) - 1))))
-    return ordered[int(rank)]
+    return nearest_rank(sorted(values), q)
 
 
 class ServeMetrics:
@@ -58,6 +61,10 @@ class ServeMetrics:
         self.clock = clock
         self._lock = threading.Lock()
         self._latencies_s: Deque[float] = collections.deque(maxlen=window)
+        # request ids aligned 1:1 with the latency window (appended
+        # together under the lock): the p99 exemplar source — trace ids
+        # a dashboard can jump from the latency percentile straight to
+        self._latency_ids: Deque[str] = collections.deque(maxlen=window)
         self._queue_s: Deque[float] = collections.deque(maxlen=window)
         self._images_ok = 0
         self._requests_ok = 0
@@ -72,10 +79,11 @@ class ServeMetrics:
     # -- recording (completion workers + submit path) ------------------------
     def record_request(
         self, n_images: int, enqueue_t: float, dispatch_t: float,
-        done_t: float,
+        done_t: float, request_id: str = "",
     ) -> None:
         with self._lock:
             self._latencies_s.append(done_t - enqueue_t)
+            self._latency_ids.append(request_id)
             self._queue_s.append(dispatch_t - enqueue_t)
             self._images_ok += n_images
             self._requests_ok += 1
@@ -113,6 +121,19 @@ class ServeMetrics:
         obsm.SERVE_REAL_ROWS.inc(real_rows)
         if bucket > real_rows:
             obsm.SERVE_PAD_ROWS.inc(bucket - real_rows)
+
+    def p99_exemplars(self, limit: int = 5) -> List[str]:
+        """Request ids of the latency window's p99 tail (most recent
+        first, capped): the exemplar hook — a dashboard reading
+        ``p99_ms`` can jump straight to the span ledgers of the
+        requests that produced it (slow-request log / flight ring)."""
+        with self._lock:
+            pairs = list(zip(self._latencies_s, self._latency_ids))
+        if not pairs:
+            return []
+        p99 = percentile([lat for lat, _ in pairs], 99)
+        out = [rid for lat, rid in reversed(pairs) if lat >= p99 and rid]
+        return out[:limit]
 
     # -- aggregation (pull-based; never on the dispatch path) ----------------
     def snapshot(self, elapsed_s: Optional[float] = None) -> dict:

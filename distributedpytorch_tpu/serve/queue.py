@@ -75,6 +75,12 @@ class ServeRequest:
     enqueue_t: float = 0.0
     deadline_t: float = 0.0
     seq: int = 0
+    # request-scoped tracing (obs/reqtrace.py): the ingress-assigned id
+    # (echoed as X-Request-Id) and the span ledger the lifecycle stamps
+    # into — None when tracing is off (DPT_OBS=0) or for bare-queue
+    # tests; every mark site guards on it
+    request_id: str = ""
+    trace: Optional[object] = None
     # prediction-cache key (serve/cache.py) stamped at admission when
     # the cache is on — the completion drain stores the masks under it,
     # but only when the weights version the dispatch actually used
@@ -138,7 +144,10 @@ class BatchingQueue:
                 return REJECT_SHUTDOWN
             if self._pending_images + req.size > self.hard_cap_images:
                 self.rejected += 1
+                # request-attributable shed record: a post-mortem can
+                # name WHICH request was shed and why, not just count
                 flight.record("queue_reject", reason=REJECT_OVERLOAD,
+                              request_id=req.request_id,
                               rows=req.size, backlog=self._pending_images)
                 return REJECT_OVERLOAD
             now = self.clock()
@@ -146,6 +155,8 @@ class BatchingQueue:
             req.deadline_t = now + self.slo_s
             req.seq = self._seq
             self._seq += 1
+            if req.trace is not None:
+                req.trace.mark("enqueued", now)
             self._pending.append(req)
             self._pending_images += req.size
             self.submitted += 1
@@ -208,6 +219,10 @@ class BatchingQueue:
             return None
         for req in take:
             self._pending.popleft()
+            if req.trace is not None:
+                # flush mark + reason: queue_wait ends here, and the
+                # ledger records WHY this group left the queue
+                req.trace.mark_flushed(now, kind, bucket)
         self._pending_images -= total
         # flush-decision telemetry (docs/OBSERVABILITY.md): a counter inc
         # + one ring slot — no allocation growth, nothing blocks

@@ -62,6 +62,7 @@ import numpy as np
 
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.obs.reqtrace import ReqTracer
 from distributedpytorch_tpu.serve.bucketing import stack_group
 from distributedpytorch_tpu.serve.cache import PredictionCache, request_key
 from distributedpytorch_tpu.serve.engine import Replica, ServeEngine
@@ -110,6 +111,10 @@ class ServeResponse:
     masks: Optional[List[np.ndarray]] = None
     latency_ms: float = 0.0
     cached: bool = False
+    # the ingress-assigned trace id (obs/reqtrace.py): echoed as
+    # X-Request-Id by the HTTP front, the join key into the slow-request
+    # log / flight ring / Perfetto timeline
+    request_id: str = ""
 
     @property
     def ok(self) -> bool:
@@ -130,6 +135,19 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
     try:
         probs = np.asarray(out)  # device→host; blocks until compute done
         done_t = server.clock()
+        # per-bucket service-time profile: one observation per executed
+        # bucket (the calibration input plan-serve replays traces
+        # against), tagged with the flush reason the queue stamped
+        first_trace = next(
+            (req.trace for req in reqs if req.trace is not None), None,
+        )
+        server.tracer.record_dispatch(
+            bucket, sum(req.size for req in reqs),
+            device_exec_s=done_t - dispatch_t,
+            flush_reason=(
+                first_trace.flush_reason if first_trace is not None else None
+            ),
+        )
         row = 0
         for req in reqs:
             masks = [
@@ -137,9 +155,6 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
                 for i in range(req.size)
             ]
             row += req.size
-            server.metrics.record_request(
-                req.size, req.enqueue_t, dispatch_t, done_t
-            )
             cache_key = getattr(req, "cache_key", None)
             if (cache_key is not None
                     and server.predict_cache is not None
@@ -152,11 +167,29 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
                 # promoted version's key, even if the canary has since
                 # rolled back
                 server.predict_cache.put(cache_key, masks)
+            resolve_t = server.clock()
+            if req.trace is not None:
+                req.trace.mark("device_done", done_t)
+                req.trace.mark("resolved", resolve_t)
+            server.metrics.record_request(
+                req.size, req.enqueue_t, dispatch_t, done_t,
+                request_id=req.request_id,
+            )
             req.future.set_result(ServeResponse(
                 key=req.key, status=STATUS_OK, masks=masks,
                 latency_ms=(done_t - req.enqueue_t) * 1e3,
+                request_id=req.request_id,
             ))
+            # close the ledger AFTER the future resolves: the drain span
+            # honestly covers slice/threshold/fan-out
+            server.tracer.complete(req.trace, STATUS_OK, t=resolve_t)
         server._completed += len(reqs)  # heartbeat progress (serve beats)
+        timeline = server.tracer.timeline
+        if timeline is not None:
+            # the drain is the sanctioned blocking context: JSONL spans
+            # append once per completed GROUP, like training's per-step
+            # flush cadence
+            timeline.flush()
     except Exception as exc:  # noqa: BLE001 — a drain failure must fail
         logger.exception("completion drain failed for bucket %d", bucket)
         for req in reqs:  # the requests, never hang their futures
@@ -164,7 +197,9 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
                 server.metrics.record_failure()
                 req.future.set_result(ServeResponse(
                     key=req.key, status=STATUS_ERROR, reason=str(exc),
+                    request_id=req.request_id,
                 ))
+                server.tracer.complete(req.trace, STATUS_ERROR)
     finally:
         server._free.put(replica)
         # capacity just freed: wake the queue so an eager flush happens
@@ -189,11 +224,33 @@ class Server:
         restart_limit: int = 3,
         restart_backoff_s: float = 0.25,
         predict_cache_mb: int = 0,
+        slow_request_ms: float = 0.0,
+        latency_slo_ms: Optional[float] = None,
+        timeline=None,
         clock=time.monotonic,
     ):
         self.engine = engine
         self.clock = clock
         self.metrics = ServeMetrics(clock=clock)
+        # request-scoped tracing (obs/reqtrace.py, docs/OBSERVABILITY.md
+        # "Request tracing"): span ledgers, per-phase attribution, SLO
+        # burn-rate windows, per-bucket service-time profiles.
+        # latency_slo_ms defaults to 2x the batching SLO (the burn
+        # windows' good-request bound); slow_request_ms <= 0 defaults to
+        # 2x that again (the structured-log threshold).
+        self.tracer = ReqTracer(
+            slo_s=float(slo_ms) / 1e3,
+            latency_slo_s=(
+                float(latency_slo_ms) / 1e3
+                if latency_slo_ms is not None else None
+            ),
+            slow_s=(
+                float(slow_request_ms) / 1e3
+                if slow_request_ms and slow_request_ms > 0 else None
+            ),
+            clock=clock,
+            timeline=timeline,
+        )
         self.slo_ms = float(slo_ms)
         self.hard_cap_images = hard_cap_images
         self.queue = self._new_queue()
@@ -362,19 +419,31 @@ class Server:
             if not req.future.done():
                 req.future.set_result(ServeResponse(
                     key=req.key, status=STATUS_SHUTDOWN, reason="shutdown",
+                    request_id=req.request_id,
                 ))
+                self.tracer.complete(req.trace, STATUS_SHUTDOWN)
         if self._thread is not None:
             self._thread.join(timeout=timeout)
         self._completion.shutdown(wait=True)
+        timeline = self.tracer.timeline
+        if timeline is not None:
+            timeline.flush()
 
     # -- ingress -------------------------------------------------------------
-    def submit(self, images, key: str = "") -> "concurrent.futures.Future":
+    def submit(self, images, key: str = "",
+               request_id: Optional[str] = None,
+               ) -> "concurrent.futures.Future":
         """Admit one request. ``images``: a single ``(H, W, C)`` row, a
         ``(k, H, W, C)`` stack, a list of rows, or a list of path
         strings / PIL images (decoded through the engine's cache). The
         future ALWAYS resolves to a :class:`ServeResponse` — rejection
-        and shutdown included."""
+        and shutdown included. ``request_id`` is the caller-supplied
+        trace id (W3C ``traceparent`` at the HTTP front); None assigns
+        one — every response carries it, and every 503 path stamps it
+        into the flight ring with its reason."""
         future: concurrent.futures.Future = concurrent.futures.Future()
+        trace = self.tracer.begin(request_id=request_id)
+        rid = trace.request_id if trace is not None else (request_id or "")
         state = self._state
         if state != STATE_SERVING:
             # between dispatch-core incarnations ("retry here shortly")
@@ -385,8 +454,9 @@ class Server:
             status = (STATUS_REJECTED if state == STATE_RELAUNCHING
                       else STATUS_SHUTDOWN)
             self.metrics.record_rejection(reason)
+            self.tracer.reject(trace, reason, request_id=rid, state=state)
             future.set_result(ServeResponse(
-                key=key, status=status, reason=reason,
+                key=key, status=status, reason=reason, request_id=rid,
             ))
             return future
         try:
@@ -394,24 +464,36 @@ class Server:
             rows = self._as_rows(images)
         except Exception as exc:  # noqa: BLE001 — bad input is a response
             self.metrics.record_failure()
+            self.tracer.complete(trace, STATUS_ERROR)
             future.set_result(ServeResponse(
                 key=key, status=STATUS_ERROR, reason=str(exc),
+                request_id=rid,
             ))
             return future
         cache_key = None
         cache_version = 0
-        if self.predict_cache is not None and not self.engine.versions_mixed:
+        # a canary in flight forces prediction-cache bypass (one key,
+        # two answers) — remembered so a shed during the bypass window
+        # is attributable to it in the flight ring
+        cache_bypassed = (
+            self.predict_cache is not None and self.engine.versions_mixed
+        )
+        if cache_bypassed:
+            self.predict_cache.record_bypass()
+        if self.predict_cache is not None and not cache_bypassed:
             cache_version = self.engine.weights_version
             cache_key = request_key(rows, cache_version)
             cached = self.predict_cache.get(cache_key)
             if cached is not None:
                 self.metrics.record_cached(len(rows))
+                self.tracer.complete(trace, "cached")
                 future.set_result(ServeResponse(
                     key=key, status=STATUS_OK, masks=list(cached),
-                    latency_ms=0.0, cached=True,
+                    latency_ms=0.0, cached=True, request_id=rid,
                 ))
                 return future
         req = ServeRequest(images=rows, future=future, key=key,
+                           request_id=rid, trace=trace,
                            cache_key=cache_key, cache_version=cache_version)
         reason = self.queue.submit(req)
         if reason is not None:
@@ -421,12 +503,14 @@ class Server:
                 # away — don't send the client elsewhere over a blip
                 reason = REJECT_RELAUNCHING
             self.metrics.record_rejection(reason)
+            self.tracer.reject(trace, reason, request_id=rid,
+                               rows=len(rows), cache_bypassed=cache_bypassed)
             # a stopping server answers "shutdown" (retry elsewhere),
             # not "overloaded" (back off and retry HERE)
             status = (STATUS_SHUTDOWN if reason == REJECT_SHUTDOWN
                       else STATUS_REJECTED)
             future.set_result(ServeResponse(
-                key=key, status=status, reason=reason,
+                key=key, status=status, reason=reason, request_id=rid,
             ))
         return future
 
@@ -499,13 +583,21 @@ class Server:
                 if not req.future.done():
                     req.future.set_result(ServeResponse(
                         key=req.key, status=STATUS_SHUTDOWN,
-                        reason="shutdown",
+                        reason="shutdown", request_id=req.request_id,
                     ))
+                    self.tracer.complete(req.trace, STATUS_SHUTDOWN)
             return None
         try:
             rows = [row for req in reqs for row in req.images]
             batch = stack_group(rows, bucket)
-            return replica, self.engine.place(replica, batch), bucket, reqs
+            placed = replica, self.engine.place(replica, batch), bucket, reqs
+            placed_t = self.clock()
+            for req in reqs:
+                if req.trace is not None:
+                    # placement span ends here: slot-claim backpressure
+                    # + stack/pad + H2D all attributed to `placement`
+                    req.trace.mark("placed", placed_t)
+            return placed
         except BaseException as exc:  # noqa: BLE001 — contain to the group:
             # resolve ITS futures and return the claimed slot; letting
             # this propagate through the prefetch worker would kill the
@@ -518,7 +610,9 @@ class Server:
                     self.metrics.record_failure()
                     req.future.set_result(ServeResponse(
                         key=req.key, status=STATUS_ERROR, reason=str(exc),
+                        request_id=req.request_id,
                     ))
+                    self.tracer.complete(req.trace, STATUS_ERROR)
             return _PLACE_FAILED
 
     def _claim_replica(self) -> Optional[Replica]:
@@ -560,6 +654,12 @@ class Server:
                             os.environ.get("DPT_FAULT_HANG_S", "600")
                         ))
                     dispatch_t = self.clock()
+                    for req in reqs:
+                        if req.trace is not None:
+                            # dispatch_wait ends here — a wedged
+                            # replica/predecessor stalling the loop is
+                            # what this span catches
+                            req.trace.mark("dispatched", dispatch_t)
                     flight.record("serve_dispatch", bucket=bucket,
                                   reqs=len(reqs))
                     out = self.engine.run(replica, x_dev)
@@ -587,7 +687,9 @@ class Server:
                             req.future.set_result(ServeResponse(
                                 key=req.key, status=STATUS_ERROR,
                                 reason="dispatch failed",
+                                request_id=req.request_id,
                             ))
+                            self.tracer.complete(req.trace, STATUS_ERROR)
                     raise
         except BaseException as exc:  # noqa: BLE001 — fail pending futures
             self._dispatch_error = exc
@@ -605,7 +707,9 @@ class Server:
                 if not req.future.done():
                     req.future.set_result(ServeResponse(
                         key=req.key, status=STATUS_ERROR, reason=str(exc),
+                        request_id=req.request_id,
                     ))
+                    self.tracer.complete(req.trace, STATUS_ERROR)
         finally:
             # Groups flushed from the queue but still buffered in the
             # placement pipeline when the loop exits would otherwise
@@ -627,7 +731,9 @@ class Server:
                     if not req.future.done():
                         req.future.set_result(ServeResponse(
                             key=req.key, status=status, reason=reason,
+                            request_id=req.request_id,
                         ))
+                        self.tracer.complete(req.trace, status)
 
     # -- factory -------------------------------------------------------------
     @classmethod
@@ -670,6 +776,8 @@ class Server:
             restart_limit=getattr(cfg, "restart_limit", 3),
             restart_backoff_s=getattr(cfg, "restart_backoff_s", 0.25),
             predict_cache_mb=getattr(cfg, "predict_cache_mb", 0),
+            slow_request_ms=getattr(cfg, "slow_request_ms", 0.0),
+            latency_slo_ms=getattr(cfg, "latency_slo_ms", None),
         )
         kwargs.update(overrides)
         server = cls(engine, **kwargs)
@@ -694,6 +802,12 @@ class Server:
             "predict_cache": (
                 self.predict_cache.snapshot()
                 if self.predict_cache is not None else None
+            ),
+            # request-tracing additions (obs/reqtrace.py): per-phase
+            # tail-latency attribution, slow-request count, SLO burn
+            # state, and the p99 window's exemplar trace ids
+            "attribution": self.tracer.snapshot_attribution(
+                exemplars=self.metrics.p99_exemplars()
             ),
         })
         return snap

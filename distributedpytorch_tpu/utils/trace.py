@@ -63,12 +63,20 @@ class StepTimeline:
         # whole run even though events are dumped incrementally
         self._totals: Dict[str, List[float]] = {}  # phase -> [count, total_s]
 
-    def record(self, phase: str, t0: float, t1: float, **tags) -> None:
+    def record(self, phase: str, t0: float, t1: float,
+               wall: Optional[float] = None, **tags) -> None:
+        """``wall`` defaults to now — right for spans recorded at their
+        own end (the ``span()`` context manager). Callers that record a
+        request's WHOLE ledger at completion (obs/reqtrace.py) pass each
+        phase's true end-of-phase wall time instead, so the trace hub's
+        ``wall − (t1 − t0)`` anchor lands every phase at its real start
+        rather than collapsing them all onto the completion instant."""
         flight.record_span(phase, t0, t1, rank=self.rank, **tags)
         if not self.enabled:
             return
         event = {"phase": phase, "t0": round(t0, 6), "t1": round(t1, 6),
-                 "wall": round(time.time(), 6), "rank": self.rank, **tags}
+                 "wall": round(wall if wall is not None else time.time(), 6),
+                 "rank": self.rank, **tags}
         with self._lock:
             self._events.append(event)
             acc = self._totals.setdefault(phase, [0, 0.0])

@@ -605,6 +605,47 @@ class TestObsHotPathRule:
         )
         assert lint.lint_source(src, "pkg/train/x.py") == []
 
+    def test_mark_fn_unbounded_append_flagged(self):
+        """ISSUE 13: the rule reaches obs/reqtrace.py's request-trace
+        lifecycle — ``mark_*`` stamps ride the serve dispatch hot path
+        and ``complete`` appends ledgers, so both are record scope."""
+        src = (
+            "class T:\n"
+            "    def __init__(self):\n"
+            "        self._spans = []\n"
+            "    def mark_flushed(self, t):\n"
+            "        self._spans.append(t)\n"
+        )
+        findings = lint.lint_source(
+            src, "distributedpytorch_tpu/obs/reqtrace.py"
+        )
+        assert [f.rule for f in findings] == ["obs-hot-path"]
+        assert "deque(maxlen" in findings[0].message
+
+    def test_complete_fn_blocking_sync_flagged(self):
+        src = (
+            "import numpy as np\n"
+            "class T:\n"
+            "    def complete(self, out):\n"
+            "        return np.asarray(out)\n"
+        )
+        findings = lint.lint_source(
+            src, "distributedpytorch_tpu/obs/reqtrace.py"
+        )
+        assert "obs-hot-path" in [f.rule for f in findings]
+
+    def test_shipped_reqtrace_module_is_clean(self):
+        """The real obs/reqtrace.py under the extended rule: ledger and
+        profile appends are deque(maxlen=...) rings, nothing blocks."""
+        import distributedpytorch_tpu.obs.reqtrace as reqtrace_mod
+
+        path = reqtrace_mod.__file__
+        findings = lint.lint_file(
+            path,
+            root=os.path.dirname(os.path.dirname(os.path.dirname(path))),
+        )
+        assert findings == [], findings
+
     def test_shipped_obs_package_is_clean(self):
         import distributedpytorch_tpu.obs as obs_pkg
 

@@ -1,0 +1,761 @@
+"""Request-scoped tracing (obs/reqtrace.py, ISSUE 13): span-ledger
+math under a fake clock, deterministic per-phase attribution of
+injected delays, the dpt_serve_profile calibration artifact, SLO
+burn-rate windows, shed attribution in the flight ring, the HTTP
+trace-id surface (traceparent in, X-Request-Id out), and the fleet
+pane (merged worker-labeled /metrics + merged fleet timeline)."""
+
+import http.client
+import io
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.obs.reqtrace import (
+    PROFILE_KIND,
+    PROFILE_VERSION,
+    ReqTracer,
+    RequestTrace,
+    load_profile,
+    new_request_id,
+    parse_traceparent,
+    request_id_from_headers,
+    save_profile,
+)
+
+SIZE_WH = (48, 32)  # (W, H) CLI order → input_hw (32, 48)
+WIDTHS = (8, 16)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    """A tiny fresh-init AOT engine (the bench_serve rig — no trained
+    checkpoint needed; the tracing machinery is weight-agnostic)."""
+    import jax
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+    from distributedpytorch_tpu.serve.engine import ServeEngine
+
+    cfg = TrainConfig(model_widths=WIDTHS, compute_dtype="float32",
+                      s2d_levels=0)
+    model, init_fn = create_model(cfg)
+    params, model_state = init_fn(jax.random.key(0), (32, 48))
+    return ServeEngine(model, params, model_state, input_hw=(32, 48),
+                       bucket_sizes=(1, 2, 4), replicas=1, host_cache_mb=0)
+
+
+@pytest.fixture()
+def clean_faults():
+    from distributedpytorch_tpu.utils import faults
+
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _img(seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.random((32, 48, 3), dtype=np.float32)
+
+
+# ---------------------------------------------------------------------------
+# span-ledger math (pure fake clock, no threads, no jax)
+# ---------------------------------------------------------------------------
+class TestRequestTraceSpans:
+    def test_full_ledger_sums_to_e2e_exactly(self):
+        t = RequestTrace("rid", 10.0)
+        t.mark("enqueued", 10.004)
+        t.mark_flushed(10.030, "deadline", 4)
+        t.mark("placed", 10.041)
+        t.mark("dispatched", 10.050)
+        t.mark("device_done", 10.950)
+        t.mark("resolved", 10.951)
+        spans = t.spans()
+        assert spans == pytest.approx({
+            "decode": 0.004, "queue_wait": 0.026, "placement": 0.011,
+            "dispatch_wait": 0.009, "device_exec": 0.900, "drain": 0.001,
+        })
+        assert sum(spans.values()) == pytest.approx(t.latency_s(), abs=1e-12)
+        assert t.flush_reason == "deadline" and t.bucket == 4
+
+    def test_missing_marks_stay_contiguous(self):
+        # a request rejected before the queue: only ingress + resolved
+        t = RequestTrace("rid", 0.0)
+        t.mark("resolved", 0.5)
+        assert t.spans() == {"drain": 0.5}
+        assert sum(t.spans().values()) == pytest.approx(t.latency_s())
+
+    def test_ledger_shape(self):
+        t = RequestTrace("abc123", 1.0)
+        t.mark("enqueued", 1.5)
+        t.mark("resolved", 2.0)
+        t.status = "ok"
+        ledger = t.ledger()
+        assert ledger["request_id"] == "abc123"
+        assert ledger["latency_ms"] == 1000.0
+        assert ledger["spans_ms"] == {"decode": 500.0, "drain": 500.0}
+        json.dumps(ledger)
+
+    def test_injected_queue_stall_attributed_to_queue_wait(self):
+        """Fake-clock determinism: a 300 ms stall between admit and
+        flush lands 100% in queue_wait, nowhere else."""
+        t = RequestTrace("rid", 0.0)
+        t.mark("enqueued", 0.001)
+        t.mark_flushed(0.301, "deadline", 1)  # +300 ms injected stall
+        t.mark("placed", 0.302)
+        t.mark("dispatched", 0.303)
+        t.mark("device_done", 0.313)
+        t.mark("resolved", 0.314)
+        spans = t.spans()
+        assert spans["queue_wait"] == pytest.approx(0.300)
+        assert spans["queue_wait"] >= 0.9 * 0.300
+        assert sum(v for k, v in spans.items() if k != "queue_wait") < 0.02
+
+
+class TestTraceIds:
+    def test_traceparent_parses_and_rejects(self):
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        assert parse_traceparent(
+            f"00-{tid}-b7ad6b7169203331-01"
+        ) == tid
+        assert parse_traceparent(None) is None
+        assert parse_traceparent("") is None
+        assert parse_traceparent("00-short-bad-01") is None
+        assert parse_traceparent("garbage") is None
+
+    def test_header_resolution_order(self):
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        headers = {"traceparent": f"00-{tid}-b7ad6b7169203331-01",
+                   "X-Request-Id": "explicit"}
+        assert request_id_from_headers(headers) == tid
+        assert request_id_from_headers(
+            {"X-Request-Id": "explicit"}
+        ) == "explicit"
+        assert request_id_from_headers({}) is None
+
+    def test_new_ids_unique(self):
+        ids = {new_request_id() for _ in range(1000)}
+        assert len(ids) == 1000
+
+    def test_unsafe_client_id_rejected(self):
+        """A client X-Request-Id is echoed as a response HEADER and
+        logged verbatim — CR/LF (header injection) and any character
+        outside the safe charset must be refused, falling back to a
+        server-assigned id (review regression)."""
+        for evil in ("abc\r\nX-Evil: 1", "abc\ndef", "id with spaces",
+                     "x" * 200, "\x00", ""):
+            assert request_id_from_headers({"X-Request-Id": evil}) is None
+        assert request_id_from_headers(
+            {"X-Request-Id": "Safe_id.123:-ok"}
+        ) == "Safe_id.123:-ok"
+
+
+# ---------------------------------------------------------------------------
+# tracer aggregation under a fake clock
+# ---------------------------------------------------------------------------
+def _fake_clock():
+    state = [0.0]
+
+    def clock():
+        return state[0]
+
+    clock.state = state
+    return clock
+
+
+def _complete_one(tracer, t0, latency, status="ok"):
+    trace = tracer.begin(t=t0)
+    trace.mark("enqueued", t0 + latency * 0.1)
+    trace.mark_flushed(t0 + latency * 0.3, "full", 2)
+    trace.mark("placed", t0 + latency * 0.4)
+    trace.mark("dispatched", t0 + latency * 0.5)
+    trace.mark("device_done", t0 + latency * 0.9)
+    tracer.complete(trace, status, t=t0 + latency)
+    return trace
+
+
+class TestBurnWindows:
+    def test_burn_rates_over_fast_and_slow_windows(self):
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, slo_target=0.99, clock=clock,
+                           fast_window_s=10.0, slow_window_s=100.0)
+        # 9 good + 1 bad in the first second: 10% errors = 10x budget
+        for i in range(9):
+            _complete_one(tracer, float(i) * 0.01, 0.01)
+        trace = tracer.begin(t=1.0)
+        tracer.complete(trace, "error", t=1.0)
+        snap = tracer.snapshot_attribution(t=1.0)
+        assert snap["slo_burn"]["fast"] == pytest.approx(10.0)
+        assert snap["slo_burn"]["slow"] == pytest.approx(10.0)
+        # 50 s later the fast window has forgotten, the slow one hasn't
+        snap = tracer.snapshot_attribution(t=51.0)
+        assert snap["slo_burn"]["fast"] is None  # window empty
+        assert snap["slo_burn"]["slow"] == pytest.approx(10.0)
+        # 200 s later both are clear
+        snap = tracer.snapshot_attribution(t=201.0)
+        assert snap["slo_burn"]["slow"] is None
+
+    def test_latency_breach_burns_budget(self):
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, latency_slo_s=0.1, clock=clock)
+        _complete_one(tracer, 0.0, 0.5)  # served, but 5x the latency SLO
+        snap = tracer.snapshot_attribution(t=0.6)
+        assert snap["slo_burn"]["fast"] == pytest.approx(100.0)  # all bad
+
+    def test_rejections_burn_budget(self):
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, clock=clock)
+        tracer.reject(tracer.begin(t=0.0), "overloaded", t=0.0)
+        snap = tracer.snapshot_attribution(t=0.1)
+        assert snap["slo_burn"]["fast"] == pytest.approx(100.0)
+
+    def test_slow_request_logged_and_counted(self, caplog):
+        import logging
+
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, slow_s=0.2, clock=clock)
+        with caplog.at_level(logging.WARNING,
+                             logger="distributedpytorch_tpu.obs.reqtrace"):
+            _complete_one(tracer, 0.0, 0.5)
+        assert any("slow request" in r.getMessage()
+                   for r in caplog.records)
+        snap = tracer.snapshot_attribution(t=1.0)
+        assert snap["slow_requests"] == 1
+        # the flight ring carries the ledger too
+        kinds = [e for e in flight.get().snapshot()
+                 if e.get("kind") == "slow_request"]
+        assert kinds and kinds[-1]["spans_ms"]
+
+    def test_burn_gauges_decay_without_traffic(self):
+        """The gauges must not freeze at the last error burst once
+        traffic stops: a scrape-time refresh re-derives them from the
+        (decayed) windows (review regression)."""
+        from distributedpytorch_tpu.obs import defs as obsm
+
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, clock=clock, fast_window_s=10.0,
+                           slow_window_s=100.0)
+        tracer.complete(tracer.begin(t=0.0), "error", t=0.0)
+        assert obsm.SERVE_SLO_BURN_FAST.value == pytest.approx(100.0)
+        # 500 s later, zero traffic: both windows are empty — the
+        # scrape-time refresh must read burn 0, not the frozen burst
+        clock.state[0] = 500.0
+        tracer.refresh_burn_gauges()
+        assert obsm.SERVE_SLO_BURN_FAST.value == 0.0
+        assert obsm.SERVE_SLO_BURN_SLOW.value == 0.0
+        # snapshot_attribution keeps the gauges in step with its view
+        tracer.complete(tracer.begin(t=500.0), "error", t=500.0)
+        assert obsm.SERVE_SLO_BURN_FAST.value == pytest.approx(100.0)
+        clock.state[0] = 900.0
+        snap = tracer.snapshot_attribution()
+        assert snap["slo_burn"]["fast"] is None
+        assert obsm.SERVE_SLO_BURN_FAST.value == 0.0
+
+    def test_rejected_trace_gap_is_not_drain_and_not_exported(self):
+        """An unserved request's trailing gap must not masquerade as a
+        `drain` span (a shed storm would read as a slice/threshold
+        bottleneck), and sheds never export pseudo-spans to the
+        timeline (review regression)."""
+        from distributedpytorch_tpu.utils.trace import StepTimeline
+
+        clock = _fake_clock()
+        timeline = StepTimeline(None, enabled=True)
+        tracer = ReqTracer(slo_s=0.05, clock=clock, timeline=timeline)
+        tracer.reject(tracer.begin(t=0.0), "overloaded", t=0.4)
+        ledger = tracer.recent(1)[0]
+        assert ledger["status"] == "rejected"
+        assert "drain" not in ledger["spans_ms"]
+        assert ledger["spans_ms"]["unserved"] == pytest.approx(400.0)
+        assert timeline.events() == []  # nothing exported
+        # a served request still exports its real spans
+        _complete_one(tracer, 1.0, 0.01)
+        assert {e["phase"] for e in timeline.events()} >= {
+            "queue_wait", "device_exec",
+        }
+
+    def test_profile_ladder_matches_metrics_ladder(self):
+        """The /metrics histograms and the profile artifact must bucket
+        over the SAME ladder, or planner calibration drifts from the
+        scraped view (review regression)."""
+        from distributedpytorch_tpu.obs import defs as obsm
+        from distributedpytorch_tpu.obs.reqtrace import SERVICE_TIME_BOUNDS
+
+        assert obsm.SERVE_DEVICE_EXEC.buckets == tuple(SERVICE_TIME_BOUNDS)
+        assert obsm.SERVE_PHASE_SECONDS.buckets == tuple(SERVICE_TIME_BOUNDS)
+
+    def test_disabled_via_env(self, monkeypatch):
+        monkeypatch.setenv("DPT_OBS", "0")
+        tracer = ReqTracer()
+        assert tracer.begin() is None
+        tracer.complete(None, "ok")  # no-op, no crash
+        assert tracer.snapshot_attribution()["completed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the calibration artifact
+# ---------------------------------------------------------------------------
+class TestProfileArtifact:
+    def _tracer_with_profiles(self):
+        tracer = ReqTracer(slo_s=0.05, clock=_fake_clock())
+        for i in range(20):
+            tracer.record_dispatch(4, 3, 0.010 + 0.001 * (i % 3), "full")
+        tracer.record_dispatch(1, 1, 0.004, "deadline")
+        tracer.record_dispatch(4, 4, 0.011, "shed")
+        return tracer
+
+    def test_profile_schema_pinned(self, tmp_path):
+        tracer = self._tracer_with_profiles()
+        payload = tracer.profile_payload(image_size=[48, 32],
+                                         replicas=1)
+        assert payload["kind"] == PROFILE_KIND
+        assert payload["version"] == PROFILE_VERSION == 1
+        assert set(payload) >= {
+            "kind", "version", "created_unix", "slo_ms",
+            "latency_slo_ms", "phase_medians_ms", "buckets",
+            "image_size", "replicas",
+        }
+        b4 = payload["buckets"]["4"]
+        assert set(b4) == {
+            "dispatches", "device_exec_s", "real_rows", "pad_rows",
+            "pad_ratio", "flush_reasons",
+        }
+        assert b4["dispatches"] == 21
+        assert b4["flush_reasons"] == {"full": 20, "shed": 1}
+        assert b4["pad_rows"] == 20  # 20 dispatches of 3 real rows in 4
+        dex = b4["device_exec_s"]
+        assert dex["count"] == 21
+        assert dex["p50"] is not None and dex["p99"] is not None
+        assert dex["cumulative_buckets"][-1][0] == "+Inf"
+        assert dex["cumulative_buckets"][-1][1] == 21
+        # the ladder is cumulative-monotone
+        counts = [c for _, c in dex["cumulative_buckets"]]
+        assert counts == sorted(counts)
+        json.dumps(payload)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        tracer = self._tracer_with_profiles()
+        path = str(tmp_path / "profile.json")
+        save_profile(tracer.profile_payload(), path)
+        loaded = load_profile(path)
+        assert loaded is not None
+        assert loaded["buckets"]["1"]["dispatches"] == 1
+
+    def test_load_none_with_note_on_missing_corrupt_stale(
+            self, tmp_path, caplog):
+        import logging
+
+        with caplog.at_level(logging.WARNING):
+            assert load_profile(None) is None
+            assert load_profile(str(tmp_path / "absent.json")) is None
+            torn = tmp_path / "torn.json"
+            torn.write_text('{"kind": "dpt_serve_pro')
+            assert load_profile(str(torn)) is None
+            stale = tmp_path / "stale.json"
+            stale.write_text(json.dumps({
+                "kind": PROFILE_KIND, "version": 99, "buckets": {},
+            }))
+            assert load_profile(str(stale)) is None
+            foreign = tmp_path / "foreign.json"
+            foreign.write_text(json.dumps({"kind": "dpt_plan",
+                                           "version": 1, "points": []}))
+            assert load_profile(str(foreign)) is None
+        assert any("ignored" in r.getMessage() for r in caplog.records)
+
+
+# ---------------------------------------------------------------------------
+# deterministic queue-level attribution (fake clock + real BatchingQueue)
+# ---------------------------------------------------------------------------
+class TestQueueAttribution:
+    def _queue(self, clock, **kw):
+        from distributedpytorch_tpu.serve.bucketing import BucketPlanner
+        from distributedpytorch_tpu.serve.queue import BatchingQueue
+
+        return BatchingQueue(BucketPlanner((1, 2, 4)), slo_s=0.05,
+                             clock=clock, **kw)
+
+    def test_deadline_flush_stall_is_queue_wait(self):
+        """An SLO-deadline stall of exactly 50 ms lands in queue_wait
+        at 100% of its magnitude — pinned on the fake clock."""
+        from distributedpytorch_tpu.serve.queue import ServeRequest
+
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, clock=clock)
+        q = self._queue(clock)
+        trace = tracer.begin(t=0.0)
+        req = ServeRequest(images=[_img()], request_id=trace.request_id,
+                           trace=trace)
+        assert q.submit(req) is None
+        assert q.poll() is None  # bucket not full, deadline not reached
+        clock.state[0] = 0.05  # the SLO deadline arrives
+        got = q.poll()
+        assert got is not None and got[0] == 1
+        assert trace.marks["flushed"] == 0.05
+        assert trace.flush_reason == "deadline"
+        trace.mark("placed", 0.051)
+        trace.mark("dispatched", 0.052)
+        trace.mark("device_done", 0.060)
+        tracer.complete(trace, "ok", t=0.0605)
+        spans = tracer.recent(1)[0]["spans_ms"]
+        assert spans["queue_wait"] == pytest.approx(50.0)
+        assert spans["queue_wait"] >= 0.9 * 50.0
+        assert sum(spans.values()) == pytest.approx(60.5, abs=0.01)
+
+    def test_overload_shed_stamps_request_id_in_flight_ring(self):
+        from distributedpytorch_tpu.serve.queue import ServeRequest
+
+        clock = _fake_clock()
+        tracer = ReqTracer(slo_s=0.05, clock=clock)
+        q = self._queue(clock, hard_cap_images=4)
+        for i in range(4):
+            assert q.submit(ServeRequest(images=[_img(i)])) is None
+        trace = tracer.begin(t=0.0)
+        shed = ServeRequest(images=[_img(9)],
+                            request_id=trace.request_id, trace=trace)
+        assert q.submit(shed) == "overloaded"
+        rejects = [e for e in flight.get().snapshot()
+                   if e.get("kind") == "queue_reject"]
+        assert rejects
+        assert rejects[-1]["request_id"] == trace.request_id
+        assert rejects[-1]["reason"] == "overloaded"
+
+
+# ---------------------------------------------------------------------------
+# injected-delay attribution on the real serve pipeline (tiny engine)
+# ---------------------------------------------------------------------------
+class TestServerAttribution:
+    def _server(self, engine, **kw):
+        from distributedpytorch_tpu.serve.server import Server
+
+        return Server(engine, **kw).start()
+
+    def test_ledger_sums_to_e2e_on_served_request(self, engine):
+        server = self._server(engine)
+        try:
+            resp = server.submit(_img(), key="sum").result(30)
+            assert resp.ok and resp.request_id
+            ledger = next(d for d in server.tracer.recent()
+                          if d["request_id"] == resp.request_id)
+            total = sum(ledger["spans_ms"].values())
+            # by construction: contiguous spans between the same clock
+            # reads (tolerance = per-span ms rounding only)
+            assert total == pytest.approx(ledger["latency_ms"], abs=0.05)
+            assert set(ledger["spans_ms"]) == {
+                "decode", "queue_wait", "placement", "dispatch_wait",
+                "device_exec", "drain",
+            }
+        finally:
+            server.stop()
+
+    def test_queue_stall_attributed_on_real_server(self, engine):
+        """--no-eager + a lone request: the batching wait IS the SLO
+        (400 ms); >= 90% of it must land in queue_wait."""
+        server = self._server(engine, slo_ms=400.0, eager_when_idle=False)
+        try:
+            resp = server.submit(_img(), key="stall").result(30)
+            assert resp.ok
+            ledger = next(d for d in server.tracer.recent()
+                          if d["request_id"] == resp.request_id)
+            assert ledger["spans_ms"]["queue_wait"] >= 0.9 * 400.0
+            assert ledger["flush"] == "deadline"
+        finally:
+            server.stop()
+
+    def test_placement_stall_attributed(self, engine, monkeypatch):
+        real_place = engine.place
+
+        def slow_place(replica, batch):
+            time.sleep(0.4)
+            return real_place(replica, batch)
+
+        monkeypatch.setattr(engine, "place", slow_place)
+        server = self._server(engine)
+        try:
+            resp = server.submit(_img(), key="place").result(30)
+            assert resp.ok
+            ledger = next(d for d in server.tracer.recent()
+                          if d["request_id"] == resp.request_id)
+            assert ledger["spans_ms"]["placement"] >= 0.9 * 400.0
+            assert ledger["spans_ms"]["queue_wait"] < 0.5 * 400.0
+        finally:
+            server.stop()
+
+    def test_wedged_replica_attributed_to_dispatch_side(
+            self, engine, monkeypatch, clean_faults):
+        """serve_replica_wedge stalls the dispatch loop between `placed`
+        and `dispatched` — the wedge's whole magnitude must show up in
+        the wedged request's dispatch_wait span."""
+        from distributedpytorch_tpu.utils import faults
+
+        monkeypatch.setenv("DPT_FAULT_HANG_S", "0.4")
+        server = self._server(engine)
+        try:
+            faults.install(("serve_replica_wedge",))
+            resp = server.submit(_img(), key="wedge").result(30)
+            assert resp.ok
+            ledger = next(d for d in server.tracer.recent()
+                          if d["request_id"] == resp.request_id)
+            assert ledger["spans_ms"]["dispatch_wait"] >= 0.9 * 400.0
+            assert ledger["spans_ms"]["device_exec"] < 0.5 * 400.0
+        finally:
+            server.stop()
+
+    def test_relaunch_gap_rejection_stamped_in_flight_ring(self, engine):
+        from distributedpytorch_tpu.serve.server import STATE_RELAUNCHING
+
+        server = self._server(engine)
+        try:
+            server._state = STATE_RELAUNCHING
+            resp = server.submit(_img(), key="gap").result(5)
+            assert resp.status == "rejected"
+            assert resp.reason == "relaunching"
+            assert resp.request_id
+            rejects = [e for e in flight.get().snapshot()
+                       if e.get("kind") == "request_reject"
+                       and e.get("request_id") == resp.request_id]
+            assert rejects and rejects[-1]["reason"] == "relaunching"
+        finally:
+            server._state = "serving"
+            server.stop()
+
+    def test_p99_exemplars_name_real_requests(self, engine):
+        server = self._server(engine)
+        try:
+            ids = {server.submit(_img(i), key=str(i)).result(30).request_id
+                   for i in range(8)}
+            attribution = server.stats()["attribution"]
+            exemplars = attribution["p99_exemplars"]
+            assert exemplars and set(exemplars) <= ids
+            # and the exemplar's full ledger is recoverable from the ring
+            ledger = next(d for d in server.tracer.recent()
+                          if d["request_id"] == exemplars[0])
+            assert ledger["spans_ms"]
+        finally:
+            server.stop()
+
+    def test_slow_request_counter_on_real_server(self, engine):
+        from distributedpytorch_tpu.obs import defs as obsm
+
+        before = obsm.SERVE_SLOW_REQUESTS.value
+        server = self._server(engine, slow_request_ms=0.001)
+        try:
+            assert server.submit(_img(), key="slow").result(30).ok
+            assert obsm.SERVE_SLOW_REQUESTS.value >= before + 1
+            assert server.stats()["attribution"]["slow_requests"] >= 1
+        finally:
+            server.stop()
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: traceparent in, X-Request-Id out
+# ---------------------------------------------------------------------------
+class TestHttpTracing:
+    @pytest.fixture()
+    def http_front(self, engine):
+        from distributedpytorch_tpu.serve.cli import make_http_server
+        from distributedpytorch_tpu.serve.server import Server
+
+        server = Server(engine).start()
+        httpd = make_http_server(server, port=0)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        yield httpd.server_address[1]
+        httpd.shutdown()
+        server.stop()
+
+    def _png(self):
+        buf = io.BytesIO()
+        Image.fromarray(
+            (_img() * 255).astype(np.uint8)
+        ).save(buf, format="PNG")
+        return buf.getvalue()
+
+    def test_traceparent_adopted_and_echoed(self, http_front):
+        tid = "0af7651916cd43dd8448eb211c80319c"
+        conn = http.client.HTTPConnection("127.0.0.1", http_front,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=self._png(), headers={
+            "traceparent": f"00-{tid}-b7ad6b7169203331-01",
+        })
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("X-Request-Id") == tid
+        resp.read()
+        conn.close()
+
+    def test_assigned_id_echoed_without_traceparent(self, http_front):
+        conn = http.client.HTTPConnection("127.0.0.1", http_front,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=self._png())
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("X-Request-Id")
+        resp.read()
+        conn.close()
+
+    def test_bad_body_still_carries_request_id(self, http_front):
+        conn = http.client.HTTPConnection("127.0.0.1", http_front,
+                                          timeout=30)
+        conn.request("POST", "/predict", body=b"not an image")
+        resp = conn.getresponse()
+        assert resp.status == 400
+        rid = resp.getheader("X-Request-Id")
+        body = json.loads(resp.read())
+        assert rid and body["request_id"] == rid
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# the fleet pane: merged worker-labeled /metrics + merged fleet timeline
+# ---------------------------------------------------------------------------
+class TestFleetPane:
+    def test_merge_expositions_labels_and_validates(self):
+        from distributedpytorch_tpu.obs import defs as obsm
+        from distributedpytorch_tpu.obs.registry import (
+            REGISTRY,
+            merge_expositions,
+            validate_exposition,
+        )
+
+        obsm.SERVE_REQUESTS.labels(status="ok").inc()
+        obsm.SERVE_LATENCY.observe(0.01)
+        text = REGISTRY.expose()
+        merged = merge_expositions(text, {"0": text, "1": text})
+        families = validate_exposition(merged)  # strict: TYPE-once etc.
+        assert "dpt_serve_requests_total" in families
+        assert 'worker="0"' in merged and 'worker="1"' in merged
+        # histogram ladders survive the relabel per worker
+        assert ('dpt_serve_latency_seconds_bucket{worker="1",le="+Inf"}'
+                in merged)
+        # supervisor's own unlabeled samples still present
+        assert "\ndpt_serve_requests_total{" in merged
+
+    def test_torn_worker_scrape_skipped_whole(self):
+        from distributedpytorch_tpu.obs.registry import (
+            REGISTRY,
+            merge_expositions,
+            validate_exposition,
+        )
+
+        text = REGISTRY.expose()
+        torn = text[: len(text) // 2] + "\ngarbage !!! line"
+        merged = merge_expositions(text, {"0": text, "1": torn})
+        validate_exposition(merged)
+        assert 'worker="0"' in merged
+        assert 'worker="1"' not in merged
+
+    def test_scraper_feeds_merged_endpoint_over_http(self):
+        """Two worker-shaped metrics servers + the supervisor's merged
+        endpoint — the whole pane over real HTTP."""
+        import urllib.request
+
+        from distributedpytorch_tpu.dist.elastic import FleetMetricsScraper
+        from distributedpytorch_tpu.obs.http import start_metrics_server
+        from distributedpytorch_tpu.obs.registry import (
+            REGISTRY,
+            merge_expositions,
+            validate_exposition,
+        )
+
+        w0 = start_metrics_server(0)
+        w1 = start_metrics_server(0)
+        pane = None
+        try:
+            # worker ports are not contiguous here: point the scraper's
+            # base at w0 and patch per-rank resolution via a tiny shim
+            scraper = FleetMetricsScraper("127.0.0.1", 0, lambda: 2)
+            ports = {0: w0.port, 1: w1.port}
+            scraper.base_port = 0
+
+            def scrape_once():
+                out = {}
+                for rank, port in ports.items():
+                    out[str(rank)] = urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/metrics", timeout=5
+                    ).read().decode()
+                return out
+
+            scraper.scrape_once = scrape_once
+            latest = scraper.scrape_once()
+            assert set(latest) == {"0", "1"}
+            pane = start_metrics_server(
+                0,
+                expose_text_fn=lambda: merge_expositions(
+                    REGISTRY.expose(), latest
+                ),
+            )
+            merged = urllib.request.urlopen(
+                f"http://127.0.0.1:{pane.port}/metrics", timeout=5
+            ).read().decode()
+            validate_exposition(merged)
+            assert 'worker="0"' in merged and 'worker="1"' in merged
+        finally:
+            w0.close()
+            w1.close()
+            if pane is not None:
+                pane.close()
+
+    def test_fleet_timeline_merge_ordering_and_labels(self, tmp_path):
+        """Per-worker span JSONL files merge into ONE Perfetto trace:
+        events time-ordered across workers, process tracks labeled
+        'worker N' (the serve supervisor's merge path)."""
+        from distributedpytorch_tpu.obs import trace_hub
+
+        base = str(tmp_path / "timeline.jsonl")
+        # worker 0 writes <base>, worker 1 writes <base>.rank1 — the
+        # serve CLI's convention under the elastic supervisor
+        with open(base, "w") as f:
+            for i in range(3):
+                f.write(json.dumps({
+                    "phase": "device_exec", "t0": 1.0 + i, "t1": 1.4 + i,
+                    "wall": 100.0 + i + 0.4, "rank": 0,
+                    "request_id": f"w0-{i}",
+                }) + "\n")
+        with open(base + ".rank1", "w") as f:
+            for i in range(3):
+                f.write(json.dumps({
+                    "phase": "queue_wait", "t0": 1.2 + i, "t1": 1.5 + i,
+                    "wall": 100.0 + i + 0.5, "rank": 1,
+                    "request_id": f"w1-{i}",
+                }) + "\n")
+        trace = trace_hub.merge_timelines(base, process_label="worker")
+        names = [e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert names == ["worker 0", "worker 1"]
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert len(xs) == 6
+        # time-ordered ACROSS workers (the interleave is the point)
+        assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+        assert {e["pid"] for e in xs} == {0, 1}
+        # request ids ride into the Perfetto args
+        assert all("request_id" in e["args"] for e in xs)
+
+    def test_serve_cli_timeline_rides_trace_hub(self, engine, tmp_path):
+        """A server with an armed timeline writes per-request span JSONL
+        that the trace hub merges (the single-worker fleet pane)."""
+        from distributedpytorch_tpu.obs import trace_hub
+        from distributedpytorch_tpu.serve.server import Server
+        from distributedpytorch_tpu.utils.trace import StepTimeline
+
+        path = str(tmp_path / "serve_timeline.jsonl")
+        server = Server(engine, timeline=StepTimeline(path)).start()
+        try:
+            assert server.submit(_img(), key="t").result(30).ok
+        finally:
+            server.stop()
+        assert os.path.exists(path)
+        trace = trace_hub.merge_timelines(path, process_label="worker")
+        xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        phases = {e["name"] for e in xs}
+        assert {"queue_wait", "device_exec", "drain"} <= phases
+        # one request's phases are contiguous on the wall-anchored axis
+        spans = sorted(
+            (e["ts"], e["ts"] + e["dur"], e["name"]) for e in xs
+        )
+        for (t0a, t1a, _), (t0b, _t1b, _) in zip(spans, spans[1:]):
+            assert t0b >= t0a - 1.0  # ordered, no wild anchor collapse

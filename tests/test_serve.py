@@ -407,6 +407,9 @@ class TestStatsSchema:
         # generation, the self-healing core's state, and the
         # prediction-cache story
         "weights_version", "state", "core_restarts", "predict_cache",
+        # request-tracing addition (ISSUE 13, deliberate schema growth):
+        # per-phase tail-latency attribution + SLO burn + p99 exemplars
+        "attribution",
     }
 
     def test_stats_key_set_and_types_pinned(self, engine):
@@ -426,6 +429,18 @@ class TestStatsSchema:
             assert isinstance(stats["imgs_per_s"], float)
             assert stats["requests_ok"] == 1
             assert stats["images_ok"] == 1
+            # the attribution block's own pinned sub-schema (the fleet
+            # pane and dashboards parse these)
+            attribution = stats["attribution"]
+            assert set(attribution) == {
+                "phases", "completed", "slow_requests",
+                "slow_threshold_ms", "p99_exemplars", "slo_burn",
+            }
+            assert set(attribution["phases"]) == {
+                "decode", "queue_wait", "placement", "dispatch_wait",
+                "device_exec", "drain",
+            }
+            assert attribution["completed"] >= 1
             json.dumps(stats)  # JSON-serializable end to end
         finally:
             server.stop()
@@ -471,6 +486,24 @@ class TestBenchServe:
             assert row["p50_ms"] is not None
             assert row["p99_ms"] is not None
             assert row["imgs_per_s"] > 0
+            # every leg is a calibration run (ISSUE 13): per-phase
+            # attribution medians + the profile artifact it wrote
+            assert row["attribution"]["device_ms"] is not None
+            assert row["attribution"]["queue_wait_ms"] is not None
+            assert os.path.exists(row["profile"])
+        # the report-level calibration artifact loads through the
+        # planner-file idiom and carries per-bucket service times
+        from distributedpytorch_tpu.obs.reqtrace import load_profile
+
+        profile = load_profile(report["profile"])
+        assert profile is not None
+        assert profile["kind"] == "dpt_serve_profile"
+        assert profile["version"] == 1
+        for info in profile["buckets"].values():
+            assert info["dispatches"] >= 1
+            assert info["device_exec_s"]["p50"] is not None
+            assert info["device_exec_s"]["cumulative_buckets"][-1][0] == "+Inf"
+            assert "flush_reasons" in info and "pad_ratio" in info
         assert report["overload"]["depth_bounded"]
         # fleet legs (ISSUE 12) ride the same report; their own
         # assertions live in tests/test_serve_fleet.py

@@ -787,7 +787,15 @@ class TestElasticServeWorkload:
         assert argv[-2:] == ["--port", "9002"]  # last occurrence wins
         assert "--heartbeat-dir" in argv
         assert "--inject-fault" in argv  # chaos on attempt 0
-        assert "--trace-timeline" not in argv  # serve CLI has no tracer
+        # request tracing (ISSUE 13): serve workers DO get the timeline
+        # now — per-request span ledgers merged into the fleet pane
+        i = argv.index("--trace-timeline")
+        assert argv[i + 1] == sup._timeline_base(0)
+        off = ElasticSupervisor(
+            ["-c", "singleGPU", "--port", "9000"], nprocs=1,
+            workload="serve", run_dir=str(tmp_path / "run2"), trace=False,
+        )
+        assert "--trace-timeline" not in off._worker_argv(0, rank=0)
         relaunch = sup._worker_argv(1, rank=0)
         assert "--inject-fault" not in relaunch
         # no resume -c appended: the user's own -c rides in worker_args

@@ -24,6 +24,13 @@ identical machinery — so the bench runs on any CPU, chip-free. Wired as
 the ``serve_bench`` bench_multi config (non-collective: the static
 preflight has nothing to check and skips it).
 
+Every leg row additionally records its per-phase attribution medians
+(queue_wait/placement/device/drain — obs/reqtrace.py) and the path of
+the ``dpt_serve_profile`` v1 artifact written from that leg's
+per-bucket service-time profiles, so bench legs double as calibration
+runs for the serve capacity planner (``report["profile"]`` names the
+in-SLO leg's — the regime a plan should calibrate from).
+
 Usage:
     python tools/bench_serve.py --levels 1 4 16 --duration 5 \\
         --out serve_report.json
@@ -108,6 +115,38 @@ def _new_server(engine, args):
     ).start()
 
 
+def _leg_calibration(server, args, leg: str) -> dict:
+    """The per-leg calibration outputs every leg row records: the
+    per-phase attribution medians (queue_wait/placement/device/drain —
+    WHERE this leg's latency went) and the ``dpt_serve_profile`` v1
+    artifact written from this leg's per-bucket service-time profiles,
+    so every bench leg doubles as a calibration run for the serve
+    capacity planner (ROADMAP plan-serve)."""
+    from distributedpytorch_tpu.obs.reqtrace import save_profile
+
+    medians = server.tracer.phase_medians_ms()
+    payload = server.tracer.profile_payload(
+        phase_medians_ms=medians,
+        leg=leg,
+        image_size=list(args.image_size),
+        bucket_sizes=list(args.buckets),
+        replicas=server.engine.num_replicas,
+        eager_when_idle=not args.no_eager,
+    )
+    path = _artifact_path(args, f"profile_{leg}")
+    save_profile(payload, path)
+    return {
+        "attribution": {
+            "queue_wait_ms": medians.get("queue_wait"),
+            "placement_ms": medians.get("placement"),
+            "dispatch_wait_ms": medians.get("dispatch_wait"),
+            "device_ms": medians.get("device_exec"),
+            "drain_ms": medians.get("drain"),
+        },
+        "profile": path,
+    }
+
+
 def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
     """C workers, submit→wait→repeat for ``duration_s``. A fresh Server
     per level (the compiled engine is reused) keeps each level's metrics
@@ -139,7 +178,7 @@ def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
     elapsed = time.monotonic() - t0
     server.stop(drain=True)
     snap = server.metrics.snapshot(elapsed_s=elapsed)
-    return {
+    row = {
         "mode": "closed",
         "concurrency": concurrency,
         "requests": snap["requests_ok"],
@@ -150,6 +189,8 @@ def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
         "bucket_dispatches": snap["bucket_dispatches"],
         "errors": errors[:3],
     }
+    row.update(_leg_calibration(server, args, f"closed_c{concurrency}"))
+    return row
 
 
 def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
@@ -191,7 +232,7 @@ def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
     server.stop(drain=True)
     snap = server.metrics.snapshot(elapsed_s=elapsed)
     rejected = sum(1 for r in responses if r.status == "rejected")
-    return {
+    row = {
         "mode": label,
         "offered_imgs_per_s": round(rate_imgs_per_s, 2),
         "submitted": len(responses),
@@ -207,16 +248,24 @@ def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
         ),
         "pad_ratio": snap["pad_ratio"],
     }
+    row.update(_leg_calibration(server, args, label))
+    return row
+
+
+def _artifact_path(args, name: str) -> str:
+    """Per-leg artifact path (flight dumps, dpt_serve_profile files):
+    next to the report when ``--out`` is set, else the temp dir."""
+    import tempfile
+
+    if args.out:
+        return f"{args.out}.{name}.json"
+    return os.path.join(tempfile.gettempdir(), f"bench_serve_{name}.json")
 
 
 def _flight_path(args, leg: str) -> str:
     """Per-leg flight-recorder artifact path (bench_multi's session rows
     reference these for post-mortems)."""
-    import tempfile
-
-    if args.out:
-        return f"{args.out}.flight_{leg}.json"
-    return os.path.join(tempfile.gettempdir(), f"bench_serve_flight_{leg}.json")
+    return _artifact_path(args, f"flight_{leg}")
 
 
 def chaos_leg(engine, args, duration_s: float) -> dict:
@@ -391,6 +440,11 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
         engine, args, rate_imgs_per_s=0.6 * capacity, duration_s=leg_s,
         label="open_in_slo",
     )
+    # the headline calibration artifact: the in-SLO open-loop leg's
+    # per-bucket service-time profile (the realistic-load regime a
+    # capacity plan should be calibrated from; every leg's own profile
+    # path rides its row)
+    report["profile"] = report["in_slo"]["profile"]
     print(json.dumps(report["in_slo"]), flush=True)
     report["overload"] = open_loop(
         engine, args, rate_imgs_per_s=3.0 * capacity, duration_s=leg_s,

@@ -1,15 +1,29 @@
 #!/usr/bin/env python3
-"""CI smoke: a 2-step training run serves GET /metrics, and the
-exposition passes the strict Prometheus format checker.
+"""CI smoke: a 2-step training run serves GET /metrics, a real serve
+front answers traced requests, and every exposition — including the
+supervisor-shaped MERGED fleet endpoint — passes the strict Prometheus
+format checker.
 
-The tier-1 suite covers the same surface in-process
-(tests/test_obs.py::TestTrainingMetricsEndpoint); this script is the
-curl-shaped end-to-end — an ephemeral ``--metrics-port`` training run
-scraped over real HTTP while it trains, validated with
-``obs.validate_exposition``, asserting the train/serve/supervisor
-families are all present. Exits nonzero on any violation.
+The tier-1 suite covers the same surfaces in-process
+(tests/test_obs.py, tests/test_reqtrace.py); this script is the
+curl-shaped end-to-end:
 
-Usage: python tools/metrics_smoke.py  (CPU, no data, ~1 min cold)
+1. an ephemeral ``--metrics-port`` training run scraped over real HTTP
+   while it trains, validated with ``obs.validate_exposition``,
+   asserting the train/serve/supervisor families are all present;
+2. a tiny fresh-init serve front (the bench_serve rig) answering two
+   POST /predict requests with ``X-Request-Id`` echo, then scraped:
+   the request-tracing families (``dpt_serve_phase_seconds``,
+   ``dpt_serve_slo_burn_*``, ``dpt_serve_slow_requests_total``,
+   ``dpt_serve_device_exec_seconds``) must expose and validate, and
+   /stats must carry the ``attribution`` block with exemplars;
+3. the fleet pane: the serve scrape re-exposed worker-labeled through
+   ``merge_expositions`` on a supervisor-shaped metrics server, scraped
+   over HTTP and validated.
+
+Exits nonzero on any violation.
+
+Usage: python tools/metrics_smoke.py  (CPU, no data, ~2 min cold)
 """
 
 import json
@@ -83,9 +97,110 @@ def main() -> int:
     done.wait(timeout=300)
     if errors:
         raise SystemExit(f"training run failed: {errors[0]}")
-    print(f"metrics smoke OK: {len(families)} families, "
+
+    serve_families = _serve_and_fleet_smoke()
+    print(f"metrics smoke OK: {len(families)} train-run families, "
+          f"{serve_families} serve+fleet families, "
           f"fingerprint {health['fingerprint']['config_sha']}")
     return 0
+
+
+def _serve_and_fleet_smoke() -> int:
+    """Steps 2+3 of the module docstring: a real serve front scraped
+    over HTTP (request-tracing families present + valid), then the
+    supervisor-shaped merged fleet endpoint scraped and validated."""
+    import threading
+
+    import numpy as np
+
+    from distributedpytorch_tpu.obs import validate_exposition
+    from distributedpytorch_tpu.obs.http import start_metrics_server
+    from distributedpytorch_tpu.obs.registry import (
+        REGISTRY,
+        merge_expositions,
+    )
+    from distributedpytorch_tpu.serve.cli import make_http_server
+    from distributedpytorch_tpu.serve.engine import ServeEngine
+    from distributedpytorch_tpu.serve.server import Server
+
+    import jax
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models import create_model
+
+    cfg = TrainConfig(model_widths=(8, 16), compute_dtype="float32",
+                      s2d_levels=0)
+    model, init_fn = create_model(cfg)
+    params, model_state = init_fn(jax.random.key(0), (32, 48))
+    engine = ServeEngine(model, params, model_state, input_hw=(32, 48),
+                         bucket_sizes=(1, 2), replicas=1, host_cache_mb=0)
+    server = Server(engine, slo_ms=25.0).start()
+    httpd = make_http_server(server, port=0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        import io
+
+        from PIL import Image
+
+        rng = np.random.default_rng(0)
+        buf = io.BytesIO()
+        Image.fromarray(
+            (rng.random((32, 48, 3)) * 255).astype(np.uint8)
+        ).save(buf, format="PNG")
+        body = buf.getvalue()
+        for i in range(2):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"traceparent": f"00-{'ab%02d' % i * 8}-"
+                                        f"{'cd' * 8}-01"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                rid = resp.headers.get("X-Request-Id")
+                if not rid:
+                    raise SystemExit("no X-Request-Id echoed on /predict")
+        serve_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=60
+        ).read().decode()
+        serve_fams = validate_exposition(serve_text)
+        for family in ("dpt_serve_phase_seconds",
+                       "dpt_serve_device_exec_seconds",
+                       "dpt_serve_slo_burn_fast",
+                       "dpt_serve_slo_burn_slow",
+                       "dpt_serve_slow_requests_total"):
+            if family not in serve_fams:
+                raise SystemExit(f"no {family} in the serve /metrics")
+        stats = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=60
+        ).read())
+        attribution = stats.get("attribution")
+        if not attribution or "p99_exemplars" not in attribution:
+            raise SystemExit(f"no attribution/exemplars in /stats: "
+                             f"{sorted(stats)}")
+
+        # the fleet pane: the worker scrape merged + worker-labeled on a
+        # supervisor-shaped metrics endpoint, scraped over real HTTP
+        pane = start_metrics_server(
+            0,
+            expose_text_fn=lambda: merge_expositions(
+                REGISTRY.expose(), {"0": serve_text}
+            ),
+        )
+        try:
+            merged = urllib.request.urlopen(
+                f"http://127.0.0.1:{pane.port}/metrics", timeout=60
+            ).read().decode()
+            merged_fams = validate_exposition(merged)
+            if 'worker="0"' not in merged:
+                raise SystemExit("fleet pane lost the worker label")
+            if "dpt_serve_phase_seconds" not in merged_fams:
+                raise SystemExit("fleet pane lost the phase family")
+        finally:
+            pane.close()
+        return len(merged_fams)
+    finally:
+        httpd.shutdown()
+        server.stop(drain=True)
 
 
 if __name__ == "__main__":
