@@ -19,8 +19,16 @@ the parallelism auto-planner:
 
 which searches strategy × schedule × memory levers with zero device
 execution and emits a ranked plan file for ``bench_multi --plan``
-(analysis/planner.py, docs/PERFORMANCE.md "Planning") — and the
-serving tier:
+(analysis/planner.py, docs/PERFORMANCE.md "Planning") — its serving
+twin:
+
+    python -m distributedpytorch_tpu plan-serve --profile profile.json
+
+which replays arrival traces against profiled service times in a
+discrete-event simulation of the live queue policy and emits replica
+recommendations per (traffic, SLO) with zero devices and zero jax
+(analysis/serve_planner.py, docs/SERVING.md "Capacity planning") — and
+the serving tier:
 
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008
 
@@ -43,6 +51,12 @@ def main() -> None:
         from distributedpytorch_tpu.analysis.planner import main as plan_main
 
         sys.exit(plan_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "plan-serve":
+        from distributedpytorch_tpu.analysis.serve_planner import (
+            main as plan_serve_main,
+        )
+
+        sys.exit(plan_serve_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "serve":
         from distributedpytorch_tpu.serve.cli import main as serve_main
 

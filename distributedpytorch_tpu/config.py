@@ -455,6 +455,13 @@ class ServeConfig:
     # ring, /stats attribution, slow-request log, and flight-ring
     # reject/slow events all stay on regardless).
     trace_timeline: Optional[str] = None
+    # Arrival-trace recording (serve/sim.py ArrivalRecorder): one
+    # bounded JSONL line per ingress (wall-time, decoded rows/shape,
+    # covering bucket) — the recorded-trace input `plan-serve` replays
+    # against a profiled service-time model. None = off; the line cap
+    # bounds the file for long-running servers.
+    record_arrivals: Optional[str] = None
+    record_arrivals_limit: int = 200_000
 
     # -- transport ----------------------------------------------------------
     host: str = "127.0.0.1"

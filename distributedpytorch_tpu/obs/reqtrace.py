@@ -76,6 +76,15 @@ logger = logging.getLogger(__name__)
 PROFILE_KIND = "dpt_serve_profile"
 PROFILE_VERSION = 1
 
+
+class ProfileMismatchError(ValueError):
+    """A ``dpt_serve_profile`` that loaded fine but was measured against
+    a DIFFERENT serving configuration (bucket ladder or engine/model
+    identity) than the one being planned for. Deliberately loud — the
+    missing/corrupt case degrades to None, but a *mismatched* profile
+    would calibrate a plan with numbers from the wrong engine, which is
+    worse than no plan at all."""
+
 #: Lifecycle marks, in order. A span is the gap between two consecutive
 #: PRESENT marks, named after the LATER mark's phase (table below) — so
 #: the ledger is contiguous and its durations sum to resolved − ingress
@@ -674,6 +683,82 @@ class ReqTracer:
 
 
 # -- profile-artifact IO (the planner-file idiom; jax-free) ------------------
+def engine_fingerprint(model_arch: str = "unet",
+                       image_size=(960, 640),
+                       model_widths=None,
+                       s2d_levels: int = -1,
+                       quantize: Optional[str] = None,
+                       kernels: str = "xla") -> str:
+    """A short stable hash of the serve engine's MODEL identity — the
+    fields of ``ServeConfig`` that change what the device executes (and
+    therefore the service times a profile measures). Stamped into every
+    ``dpt_serve_profile`` and cross-checked by the ``plan-serve``
+    planner: a profile measured on a different arch / resolution /
+    quantization must refuse to calibrate a plan, loudly.
+
+    Every value here is a CONCRETE identity, defaults included:
+    ``model_widths=None`` means the arch's built-in default widths (the
+    serve path never resolves widths from checkpoint metadata — a
+    wrong-widths checkpoint fails loudly at load), so two engines
+    fingerprint equal iff they execute the same program shape. Callers
+    must pass the same flags they serve with, exactly as predict.py's
+    identity flags work."""
+    import hashlib
+
+    blob = json.dumps({
+        "model_arch": str(model_arch),
+        "image_size": [int(s) for s in image_size],
+        "model_widths": (
+            [int(w) for w in model_widths] if model_widths else None
+        ),
+        "s2d_levels": int(s2d_levels),
+        "quantize": quantize,
+        "kernels": str(kernels),
+    }, sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def check_profile(payload: dict,
+                  expect_buckets=None,
+                  expect_fingerprint: Optional[str] = None) -> None:
+    """The staleness guard: raise :class:`ProfileMismatchError` when the
+    profile's recorded bucket ladder / engine fingerprint disagree with
+    what the caller is about to plan for. An expectation the profile
+    cannot answer (no recorded field) is ALSO a refusal — "unverifiable"
+    must not read as "verified"."""
+    if expect_buckets is not None:
+        recorded = payload.get("bucket_sizes")
+        expected = [int(b) for b in expect_buckets]
+        if recorded is None:
+            raise ProfileMismatchError(
+                "profile records no bucket ladder — cannot verify it "
+                f"matches the serving ladder {expected} (re-profile with "
+                "a current bench_serve)"
+            )
+        if [int(b) for b in recorded] != expected:
+            raise ProfileMismatchError(
+                f"profile was measured on bucket ladder {recorded} but "
+                f"the serving config uses {expected} — a plan calibrated "
+                "from it would predict the wrong shapes; re-profile"
+            )
+    if expect_fingerprint is not None:
+        recorded = payload.get("engine_fingerprint")
+        if recorded is None:
+            raise ProfileMismatchError(
+                "profile records no engine fingerprint — cannot verify "
+                f"it matches engine {expect_fingerprint} (re-profile "
+                "with a current bench_serve)"
+            )
+        if str(recorded) != str(expect_fingerprint):
+            raise ProfileMismatchError(
+                f"profile was measured on engine {recorded} but the "
+                f"serving config fingerprints as {expect_fingerprint} "
+                "(different model/resolution/quantization/kernels) — "
+                "its service times do not describe this engine; "
+                "re-profile"
+            )
+
+
 def save_profile(payload: dict, path: str) -> str:
     """Atomic write of a ``dpt_serve_profile`` payload; returns ``path``."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -684,11 +769,19 @@ def save_profile(payload: dict, path: str) -> str:
     return path
 
 
-def load_profile(path: Optional[str]) -> Optional[dict]:
+def load_profile(path: Optional[str],
+                 expect_buckets=None,
+                 expect_fingerprint: Optional[str] = None) -> Optional[dict]:
     """The profile, or None (with a logged note) for missing / corrupt /
     version-skewed files — consumers (the ``plan-serve`` capacity
     planner) degrade to uncalibrated defaults on None; a torn or stale
-    artifact must never silently calibrate a plan."""
+    artifact must never silently calibrate a plan.
+
+    ``expect_buckets`` / ``expect_fingerprint`` arm the staleness guard
+    (:func:`check_profile`): a profile that loads but was measured
+    against a different bucket ladder or engine identity raises
+    :class:`ProfileMismatchError` — loudly, because a MISMATCHED
+    calibration is worse than a missing one."""
     if not path:
         return None
     try:
@@ -709,4 +802,6 @@ def load_profile(path: Optional[str]) -> Optional[dict]:
             "or foreign file)", path, PROFILE_KIND, PROFILE_VERSION,
         )
         return None
+    check_profile(payload, expect_buckets=expect_buckets,
+                  expect_fingerprint=expect_fingerprint)
     return payload

@@ -20,6 +20,17 @@ users and over-provisioning costs only money.
 ``observe_window`` is the whole policy, a pure function of one
 window's deltas — the unit tests drive it directly with fabricated
 windows and never wait out a cadence (tests/test_serve_fleet.py).
+
+This hint is the RUNTIME SHADOW of the ``plan-serve`` capacity planner
+(analysis/serve_planner.py, docs/SERVING.md "Capacity planning"): the
+planner answers "how many replicas for this traffic at this SLO" ahead
+of time from recorded traces + profiled service times; the hint watches
+the same pressure signals (shed deltas, queue depth vs the per-replica
+high-water mark) live, with hysteresis instead of simulation. On an
+obvious overload the two must agree on direction — pinned by
+tests/test_serve_planner.py's cross-check, which runs one deterministic
+scenario through BOTH and asserts the hint's scale-up matches the
+plan's recommendation.
 """
 
 from __future__ import annotations

@@ -171,6 +171,19 @@ def get_args(argv=None):
                         help="Append per-request span JSONL here (rank R "
                              "writes PATH.rankR under a supervisor); "
                              "merge to Perfetto via obs/trace_hub.py")
+    parser.add_argument("--record-arrivals", type=str, default=None,
+                        metavar="PATH",
+                        help="Record a bounded JSONL arrival trace here "
+                             "(ingress wall-time, decoded rows/shape, "
+                             "covering bucket per request; rank R of a "
+                             "supervised fleet writes PATH.rankR) — the "
+                             "recorded-trace input `plan-serve` replays "
+                             "for capacity planning (docs/SERVING.md)")
+    parser.add_argument("--record-arrivals-limit", type=int,
+                        default=200_000,
+                        help="Arrival-trace line cap: past it recording "
+                             "stops (the trace keeps the head of the "
+                             "traffic; the file stays bounded)")
     parser.add_argument("--heartbeat-dir", type=str, default=None,
                         help="Write per-rank beat files here for the "
                              "elastic supervisor (normally armed by "
@@ -225,6 +238,8 @@ def to_config(args):
         latency_slo_ms=args.latency_slo_ms,
         slow_request_ms=args.slow_request_ms,
         trace_timeline=args.trace_timeline,
+        record_arrivals=args.record_arrivals,
+        record_arrivals_limit=args.record_arrivals_limit,
         heartbeat_dir=args.heartbeat_dir,
         heartbeat_interval_s=args.heartbeat_interval,
         inject_faults=tuple(args.inject_fault),
@@ -257,6 +272,17 @@ def build_server(args):
                 else f"{cfg.trace_timeline}.rank{rank}")
         timeline = StepTimeline(path, rank=rank)
     server = Server.from_config(cfg, timeline=timeline)
+    if cfg.record_arrivals:
+        from distributedpytorch_tpu.serve.sim import ArrivalRecorder
+
+        # rank-suffixed like --trace-timeline: N supervised workers
+        # must not truncate/interleave one shared trace file
+        rank = int(os.environ.get("RANK", "0"))
+        path = (cfg.record_arrivals if rank == 0
+                else f"{cfg.record_arrivals}.rank{rank}")
+        server.arrival_recorder = ArrivalRecorder(
+            path, limit=cfg.record_arrivals_limit,
+        )
     attach_fleet(server, cfg)
     return server
 

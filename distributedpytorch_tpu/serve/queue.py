@@ -30,11 +30,16 @@ and is never split across dispatches (its response stays one piece). A
 request larger than the biggest bucket is rejected at admission with
 :data:`REJECT_TOO_LARGE` — it could never match a compiled executable.
 
-Determinism: all policy lives in ``poll()``/``_poll_locked``, driven by
-an injectable ``clock`` — the unit tests step a fake clock and never
-touch threads. ``wait_for_work`` is the thin blocking wrapper the
-server's dispatch thread uses (condition variable, woken by ``submit``
-and by the next SLO deadline).
+Determinism: all policy lives in ``serve/policy.py`` as PURE functions
+(:func:`~distributedpytorch_tpu.serve.policy.decide_flush` /
+:func:`~distributedpytorch_tpu.serve.policy.admit_decision`) that
+``poll()``/``_poll_locked`` delegate to, driven by an injectable
+``clock`` — the unit tests step a fake clock and never touch threads,
+and the ``plan-serve`` capacity simulator (serve/sim.py) replays the
+*same* policy functions against virtual time, so the simulated queue
+cannot drift from this one. ``wait_for_work`` is the thin blocking
+wrapper the server's dispatch thread uses (condition variable, woken by
+``submit`` and by the next SLO deadline).
 """
 
 from __future__ import annotations
@@ -49,16 +54,13 @@ import numpy as np
 
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.serve import policy
 from distributedpytorch_tpu.serve.bucketing import BucketPlanner
-
-#: ``submit`` rejection reasons (stable strings — they surface in bench
-#: reports and HTTP 503 bodies, so clients can switch on them).
-#: ``overloaded`` means "this instance is shedding, back off and retry";
-#: ``shutdown`` means "this instance is going away, retry elsewhere" —
-#: conflating them would have clients hammering a stopping server.
-REJECT_OVERLOAD = "overloaded"
-REJECT_TOO_LARGE = "too-large"
-REJECT_SHUTDOWN = "shutdown"
+from distributedpytorch_tpu.serve.policy import (  # noqa: F401 — re-exports
+    REJECT_OVERLOAD,
+    REJECT_SHUTDOWN,
+    REJECT_TOO_LARGE,
+)
 
 
 @dataclasses.dataclass
@@ -137,19 +139,25 @@ class BatchingQueue:
         req.size = len(req.images)
         if req.size < 1:
             raise ValueError("empty request")
-        if req.size > self.planner.max_size:
-            return REJECT_TOO_LARGE
         with self._cond:
             if self._stopped:
                 return REJECT_SHUTDOWN
-            if self._pending_images + req.size > self.hard_cap_images:
+            reason = policy.admit_decision(
+                self.planner, self._pending_images, req.size,
+                self.hard_cap_images,
+            )
+            if reason == REJECT_TOO_LARGE:
+                # could never match a compiled executable: a CLIENT
+                # error, not backpressure — no shed accounting
+                return reason
+            if reason is not None:
                 self.rejected += 1
                 # request-attributable shed record: a post-mortem can
                 # name WHICH request was shed and why, not just count
-                flight.record("queue_reject", reason=REJECT_OVERLOAD,
+                flight.record("queue_reject", reason=reason,
                               request_id=req.request_id,
                               rows=req.size, backlog=self._pending_images)
-                return REJECT_OVERLOAD
+                return reason
             now = self.clock()
             req.enqueue_t = now
             req.deadline_t = now + self.slo_s
@@ -165,65 +173,32 @@ class BatchingQueue:
             self._cond.notify_all()
         return None
 
-    # -- flush policy --------------------------------------------------------
-    def _head_group(self) -> Tuple[List[ServeRequest], int]:
-        """Longest FIFO prefix whose rows fit the largest bucket. Strictly
-        FIFO: a request that doesn't fit stops the scan (no reordering —
-        within a bucket and across buckets, completion follows submission
-        order for equal-capacity requests)."""
-        take: List[ServeRequest] = []
-        total = 0
-        for req in self._pending:
-            if total + req.size > self.planner.max_size:
-                break
-            take.append(req)
-            total += req.size
-        return take, total
-
+    # -- flush policy (pure — serve/policy.py; the simulator shares it) ------
     def _poll_locked(self, eager: bool = False):
         if not self._pending:
             return None
         now = self.clock()
-        take, total = self._head_group()
-        overloaded = self._pending_images - total >= self.planner.max_size
-        if total == self.planner.max_size or (
-            len(take) < len(self._pending) and not overloaded
-        ):
-            # head group fills (or next request overflows) the largest
-            # bucket: the throughput path
-            kind = "full"
-            bucket = self.planner.bucket_for(total)
-        elif overloaded:
-            # shed: more than a full bucket is backed up behind the head
-            # group — drop to the largest bucket the head can FILL, so
-            # no dispatched row is padding while real requests wait
-            kind = "shed"
-            bucket = self.planner.largest_full_bucket(total)
-            trimmed: List[ServeRequest] = []
-            trimmed_total = 0
-            for req in take:
-                if trimmed_total + req.size > bucket:
-                    break
-                trimmed.append(req)
-                trimmed_total += req.size
-            if trimmed:
-                take, total = trimmed, trimmed_total
-            # an unsplittable head (single request bigger than the full
-            # bucket) keeps its covering bucket, padding and all
-            bucket = self.planner.bucket_for(total)
-        elif take[0].deadline_t <= now or eager:
-            # SLO flush / work-conserving flush: smallest covering bucket
-            kind = "deadline" if take[0].deadline_t <= now else "eager"
-            bucket = self.planner.bucket_for(total)
-        else:
+        decision = policy.decide_flush(
+            self.planner,
+            [req.size for req in self._pending],
+            self._pending[0].deadline_t,
+            self._pending_images,
+            now,
+            eager=eager,
+        )
+        if decision is None:
             return None
-        for req in take:
-            self._pending.popleft()
+        kind, bucket = decision.kind, decision.bucket
+        take: List[ServeRequest] = []
+        for _ in range(decision.count):
+            req = self._pending.popleft()
+            take.append(req)
             if req.trace is not None:
                 # flush mark + reason: queue_wait ends here, and the
                 # ledger records WHY this group left the queue
                 req.trace.mark_flushed(now, kind, bucket)
-        self._pending_images -= total
+        self._pending_images -= decision.rows
+        total = decision.rows
         # flush-decision telemetry (docs/OBSERVABILITY.md): a counter inc
         # + one ring slot — no allocation growth, nothing blocks
         obsm.SERVE_FLUSHES.labels(kind=kind).inc()

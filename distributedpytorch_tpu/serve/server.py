@@ -300,6 +300,11 @@ class Server:
         self.heartbeat = None  # dist/health.Heartbeat when supervised
         self.rollout = None  # serve/rollout.RolloutManager when attached
         self.config = None  # set by from_config; /healthz fingerprint
+        # serve/sim.ArrivalRecorder when --record-arrivals is set: one
+        # bounded JSONL line per ingress (wall-time, rows, bucket) —
+        # the recorded-trace input the plan-serve capacity simulator
+        # replays; attached by serve/cli.py or the bench, closed by stop
+        self.arrival_recorder = None
 
     def _new_queue(self) -> BatchingQueue:
         return BatchingQueue(
@@ -415,6 +420,8 @@ class Server:
             component = getattr(self, attr, None)
             if component is not None:
                 component.stop()
+        if self.arrival_recorder is not None:
+            self.arrival_recorder.close()
         for req in self.queue.stop():
             if not req.future.done():
                 req.future.set_result(ServeResponse(
@@ -444,8 +451,14 @@ class Server:
         future: concurrent.futures.Future = concurrent.futures.Future()
         trace = self.tracer.begin(request_id=request_id)
         rid = trace.request_id if trace is not None else (request_id or "")
+        recorder = self.arrival_recorder
         state = self._state
         if state != STATE_SERVING:
+            if recorder is not None:
+                # the relaunch-gap/shutdown 503s are OFFERED load too —
+                # a trace missing them would replay an optimistically
+                # thinned overload (rows best-effort: no decode here)
+                recorder.record(time.time(), self._estimate_rows(images))
             # between dispatch-core incarnations ("retry here shortly")
             # or terminally stopped ("retry elsewhere") — either way an
             # immediate answer, never a queue entry a dead core strands
@@ -463,6 +476,8 @@ class Server:
             faults.maybe_raise_transient("serve_decode")
             rows = self._as_rows(images)
         except Exception as exc:  # noqa: BLE001 — bad input is a response
+            if recorder is not None:
+                recorder.record(time.time(), self._estimate_rows(images))
             self.metrics.record_failure()
             self.tracer.complete(trace, STATUS_ERROR)
             future.set_result(ServeResponse(
@@ -470,6 +485,13 @@ class Server:
                 request_id=rid,
             ))
             return future
+        if recorder is not None:
+            # record at INGRESS, before admission: a capacity replay
+            # needs the offered load, shed requests included
+            recorder.record(
+                time.time(), len(rows), shape=rows[0].shape,
+                bucket=self.engine.planner.bucket_for(len(rows)),
+            )
         cache_key = None
         cache_version = 0
         # a canary in flight forces prediction-cache bypass (one key,
@@ -540,6 +562,18 @@ class Server:
         if isinstance(images, (list, tuple)):
             return [self.engine.preprocess(src) for src in images]
         return [self.engine.preprocess(images)]  # path / PIL image
+
+    @staticmethod
+    def _estimate_rows(images) -> int:
+        """Best-effort row count for arrival recording on paths that
+        never decode (relaunch-gap 503s, undecodable bodies) — shape
+        arithmetic only, mirroring ``_as_rows``'s dispatch. The common
+        HTTP single-image case is exact (1)."""
+        if isinstance(images, np.ndarray):
+            return images.shape[0] if images.ndim == 4 else 1
+        if isinstance(images, (list, tuple)):
+            return max(1, len(images))
+        return 1
 
     # -- the serve pipeline --------------------------------------------------
     def _bucket_stream(self, queue: BatchingQueue, gen_stop: threading.Event):

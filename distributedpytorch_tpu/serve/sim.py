@@ -1,0 +1,533 @@
+"""The serve tier's discrete-event simulator: replay arrival traces
+against the REAL queue policy with profiled service times.
+
+The capacity question — "how many replicas for this traffic at this
+SLO?" — only needed two inputs the live system wasn't exposing as
+artifacts until PR 13: *when requests arrive* (the arrival trace) and
+*how long a bucket takes on the device* (the per-bucket service-time
+histograms in the ``dpt_serve_profile`` v1 artifact every bench_serve
+leg now writes). Given both, a discrete-event simulation answers the
+question on any CPU in milliseconds — the serve-tier analogue of PR
+10's rank-on-CPU/spend-chips-on-winners planner, and the established
+shape for LLM-serving capacity planning (Vidur, MLSys '24).
+
+What is simulated, and how honestly:
+
+* **policy** — admission and flushing call the *same* pure functions
+  the live queue executes (``serve/policy.py``: full / deadline /
+  eager / shed + the hard admission cap). There is no reimplementation
+  to drift.
+* **service times** — sampled per bucket from the profile's cumulative
+  device-exec histograms by inverse-CDF interpolation
+  (:class:`ServiceModel`). Buckets the profile never observed are
+  scaled linearly in rows from the nearest observed bucket, and the
+  model says so in ``notes`` (a plan built on scaled buckets is a
+  what-if, not a calibration).
+* **replicas** — each replica is modeled as ``inflight_per_replica``
+  service CHANNELS (the live pipeline's in-flight slots: one bucket
+  executing + one dispatched behind it). The channel, not the replica,
+  is the unit the profile measures: the host-observed ``device_exec``
+  span runs dispatched→device-done per SLOT, so where real in-flight
+  buckets serialize on the accelerator the measured spans already
+  stretch to absorb it, and where they genuinely overlap (H2D under
+  compute; the CPU backend) the spans overlap too — channels × span
+  reproduces live throughput either way. Flushed groups buffer
+  ``dispatch_buffer`` deep ahead of the channels (the placement-depth
+  analogue) so deadline flushes under load still leave the queue.
+* **constant overheads** — decode + placement + drain medians from the
+  profile's ``phase_medians_ms`` ride every completed request as a
+  constant adder; queue_wait / dispatch_wait / device_exec are what the
+  event loop itself produces.
+
+Deterministic by construction: virtual time only, one seeded
+``random.Random`` stream, no wall clock, no threads — the same trace +
+profile + seed gives the bit-identical result the plan artifact test
+pins. Jax-free and import-light (numpy only via serve/bucketing).
+
+Workloads:
+
+* :func:`poisson_arrivals` — open-loop Poisson at a fixed rate (the
+  coordinated-omission-free real-traffic shape);
+* :func:`load_arrival_trace` — a recorded ``dpt_serve_arrivals`` JSONL
+  (the serve front's ``--record-arrivals``, bench_serve's per-leg
+  recordings) replayed verbatim;
+* ``closed_concurrency`` — C closed-loop clients, submit→wait→repeat
+  (bench_serve's closed legs).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import heapq
+import itertools
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributedpytorch_tpu.serve import policy
+from distributedpytorch_tpu.serve.bucketing import BucketPlanner
+
+logger = logging.getLogger(__name__)
+
+#: Recorded arrival-trace identity (first JSONL line is a header with
+#: these — the planner-file idiom, same refusal rules as profiles).
+TRACE_KIND = "dpt_serve_arrivals"
+TRACE_VERSION = 1
+
+
+# -- arrival traces: recording + loading + synthesis -------------------------
+class ArrivalRecorder:
+    """Bounded JSONL recorder for the serve front's ``--record-arrivals``:
+    one line per ingress (wall-time, decoded rows/shape, covering
+    bucket), capped at ``limit`` lines so a long-running server can't
+    grow a trace file without bound — past the cap, recording stops
+    with one logged note (the head of the traffic is the trace).
+
+    Thread-safe (ingress runs on HTTP handler threads); writes ride the
+    file object's buffering and flush on :meth:`close`.
+
+    An existing non-empty trace is APPENDED to, not truncated: a
+    supervised serve worker relaunched after a crash (the PR-12 drill)
+    must not discard the offered load it recorded before dying. The
+    loader skips the extra header lines later incarnations would write
+    — only a fresh file gets one."""
+
+    def __init__(self, path: str, limit: int = 200_000):
+        self.path = str(path)
+        self.limit = max(1, int(limit))
+        self.recorded = 0
+        self._lock = threading.Lock()
+        parent = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(parent, exist_ok=True)
+        fresh = not os.path.exists(self.path) or (
+            os.path.getsize(self.path) == 0
+        )
+        self._f = open(self.path, "w" if fresh else "a")
+        if fresh:
+            self._f.write(json.dumps({
+                "kind": TRACE_KIND, "version": TRACE_VERSION,
+                "created_unix": round(time.time(), 3),
+            }) + "\n")
+        self._capped_logged = False
+
+    def record(self, t_wall: float, rows: int,
+               shape: Optional[Sequence[int]] = None,
+               bucket: Optional[int] = None) -> None:
+        with self._lock:
+            if self._f is None:
+                return
+            if self.recorded >= self.limit:
+                if not self._capped_logged:
+                    self._capped_logged = True
+                    logger.warning(
+                        "arrival trace %s reached its %d-line cap — "
+                        "recording stopped (the trace keeps the head of "
+                        "the traffic)", self.path, self.limit,
+                    )
+                return
+            rec = {"t": round(float(t_wall), 6), "rows": int(rows)}
+            if shape is not None:
+                rec["shape"] = [int(s) for s in shape]
+            if bucket is not None:
+                rec["bucket"] = int(bucket)
+            self._f.write(json.dumps(rec) + "\n")
+            self.recorded += 1
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def load_arrival_trace(path: Optional[str]) -> Optional[List[Tuple[float, int]]]:
+    """A recorded trace as ``[(t, rows), ...]`` with ``t`` normalized to
+    start at 0, or None (with a logged note) for missing / unreadable /
+    foreign files — the planner-file idiom: a torn or foreign trace must
+    never silently shape a capacity plan. Individual malformed lines
+    after a valid header are skipped (a crash mid-append loses the tail,
+    not the trace)."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            lines = f.read().splitlines()
+    except OSError as exc:
+        logger.warning("arrival trace %r unreadable (%s) — ignored",
+                       path, type(exc).__name__)
+        return None
+    if not lines:
+        logger.warning("arrival trace %r is empty — ignored", path)
+        return None
+    try:
+        header = json.loads(lines[0])
+    except ValueError:
+        header = None
+    if (
+        not isinstance(header, dict)
+        or header.get("kind") != TRACE_KIND
+        or header.get("version") != TRACE_VERSION
+    ):
+        logger.warning(
+            "arrival trace %r is not a %s v%d file — ignored (stale or "
+            "foreign)", path, TRACE_KIND, TRACE_VERSION,
+        )
+        return None
+    arrivals: List[Tuple[float, int]] = []
+    for line in lines[1:]:
+        try:
+            rec = json.loads(line)
+            arrivals.append((float(rec["t"]), max(1, int(rec["rows"]))))
+        except (ValueError, KeyError, TypeError):
+            continue  # torn tail line
+    if not arrivals:
+        logger.warning("arrival trace %r has a header but no arrivals — "
+                       "ignored", path)
+        return None
+    arrivals.sort(key=lambda a: a[0])
+    t0 = arrivals[0][0]
+    return [(t - t0, rows) for t, rows in arrivals]
+
+
+def poisson_arrivals(rate_rps: float, duration_s: float, seed: int = 0,
+                     rows_per_request: int = 1) -> List[Tuple[float, int]]:
+    """Open-loop Poisson arrivals: ``rate_rps`` requests/s for
+    ``duration_s`` virtual seconds, deterministic per seed."""
+    rng = random.Random(seed)
+    rate = max(float(rate_rps), 1e-9)
+    out: List[Tuple[float, int]] = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            return out
+        out.append((t, int(rows_per_request)))
+
+
+# -- service-time model ------------------------------------------------------
+class ServiceModel:
+    """Per-bucket device-exec sampler calibrated from a loaded
+    ``dpt_serve_profile`` payload: inverse-CDF interpolation over each
+    bucket's cumulative histogram. ``overhead_s`` is the constant
+    decode+placement+drain adder from the profile's phase medians."""
+
+    def __init__(self, profile: dict):
+        self.notes: List[str] = []
+        self._segments: Dict[int, List[Tuple[float, float, int]]] = {}
+        self._total: Dict[int, int] = {}
+        self._mean: Dict[int, float] = {}
+        for key, info in (profile.get("buckets") or {}).items():
+            try:
+                bucket = int(key)
+                hist = info["device_exec_s"]["cumulative_buckets"]
+                count = int(info["device_exec_s"]["count"])
+                mean = info["device_exec_s"].get("mean")
+            except (KeyError, TypeError, ValueError):
+                continue
+            if count < 1:
+                continue
+            segments: List[Tuple[float, float, int]] = []
+            lo = 0.0
+            prev_cum = 0
+            last_finite = 0.0
+            for bound, cum in hist:
+                if bound == "+Inf":
+                    # overflow mass: bounded at 2x the last finite bound
+                    hi = max(last_finite * 2.0, last_finite + 1e-6)
+                else:
+                    hi = float(bound)
+                    last_finite = hi
+                seg_count = int(cum) - prev_cum
+                prev_cum = int(cum)
+                if seg_count > 0:
+                    segments.append((lo, hi, seg_count))
+                lo = hi if bound != "+Inf" else lo
+            if not segments:
+                continue
+            self._segments[bucket] = segments
+            self._total[bucket] = sum(c for _, _, c in segments)
+            self._mean[bucket] = (
+                float(mean) if mean is not None
+                else sum((lo + hi) / 2 * c for lo, hi, c in segments)
+                / self._total[bucket]
+            )
+        if not self._segments:
+            raise ValueError(
+                "profile has no usable per-bucket service-time histograms "
+                "— nothing to calibrate a simulation from"
+            )
+        medians = profile.get("phase_medians_ms") or {}
+        self.overhead_s = sum(
+            (medians.get(phase) or 0.0) / 1e3
+            for phase in ("decode", "placement", "drain")
+        )
+        self._scaled: Dict[int, int] = {}
+
+    def buckets(self) -> List[int]:
+        return sorted(self._segments)
+
+    def _base_bucket(self, bucket: int) -> int:
+        """Nearest profiled bucket (by row-count ratio) to scale an
+        unprofiled bucket's sample from — noted once per bucket: plans
+        leaning on scaled buckets are what-ifs, not calibrations."""
+        cached = self._scaled.get(bucket)
+        if cached is not None:
+            return cached
+        base = min(
+            self._segments,
+            key=lambda b: (abs(b - bucket), b),
+        )
+        self._scaled[bucket] = base
+        self.notes.append(
+            f"bucket {bucket} unprofiled — service times scaled "
+            f"linearly in rows from profiled bucket {base}"
+        )
+        return base
+
+    def sample(self, bucket: int, rng: random.Random) -> float:
+        b = int(bucket)
+        if b in self._segments:
+            base, scale = b, 1.0
+        else:
+            base = self._base_bucket(b)
+            scale = b / base
+        u = rng.random() * self._total[base]
+        acc = 0
+        for lo, hi, count in self._segments[base]:
+            if u <= acc + count:
+                frac = (u - acc) / count
+                return max(1e-9, (lo + (hi - lo) * frac) * scale)
+            acc += count
+        lo, hi, _count = self._segments[base][-1]
+        return max(1e-9, hi * scale)
+
+    def mean_service_s(self, bucket: int) -> float:
+        b = int(bucket)
+        if b in self._mean:
+            return self._mean[b]
+        base = self._base_bucket(b)
+        return self._mean[base] * (b / base)
+
+    def capacity_rows_per_s(self, bucket_sizes: Sequence[int],
+                            replicas: int,
+                            inflight_per_replica: int = 1) -> float:
+        """Best-case steady-state throughput: every dispatch rides the
+        largest bucket, fully packed, on every service channel — the
+        planner's default rate-ladder anchor (``inflight_per_replica=1``
+        keeps the anchor conservative)."""
+        top = max(bucket_sizes)
+        channels = replicas * max(1, int(inflight_per_replica))
+        return channels * top / max(self.mean_service_s(top), 1e-9)
+
+
+# -- the event loop ----------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class SimKnobs:
+    """One grid point's queue/serving knobs — mirrors ``ServeConfig``'s
+    batching+execution surface (bucket ladder, SLO, replica count,
+    eager/shed via the shared policy, admission cap)."""
+
+    bucket_sizes: Tuple[int, ...] = (1, 2, 4, 8)
+    slo_s: float = 0.05
+    replicas: int = 1
+    eager: bool = True
+    hard_cap_images: Optional[int] = None  # None → 4x largest bucket
+    # dispatched-but-undrained buckets per replica (ServeConfig's
+    # inflight_per_replica): the service channels — see module docstring
+    inflight_per_replica: int = 2
+    dispatch_buffer: int = 2  # flushed groups buffered ahead of channels
+    seed: int = 0
+
+    def resolved_cap(self) -> int:
+        if self.hard_cap_images is not None:
+            return int(self.hard_cap_images)
+        return 4 * max(self.bucket_sizes)
+
+    @property
+    def channels(self) -> int:
+        return max(1, int(self.replicas)) * max(
+            1, int(self.inflight_per_replica)
+        )
+
+
+@dataclasses.dataclass
+class SimResult:
+    submitted: int
+    completed: int
+    completed_rows: int
+    shed: int
+    duration_s: float
+    p50_ms: Optional[float]
+    p99_ms: Optional[float]
+    shed_rate: float
+    imgs_per_s: float
+    queue_depth_max: int
+    utilization: float
+    pad_ratio: float
+    flush_mix: Dict[str, int]
+
+    def payload(self) -> dict:
+        """The deterministic dict the plan artifact embeds (rounded so
+        formatting can't wobble across platforms)."""
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "completed_rows": self.completed_rows,
+            "shed": self.shed,
+            "duration_s": round(self.duration_s, 6),
+            "p50_ms": None if self.p50_ms is None else round(self.p50_ms, 3),
+            "p99_ms": None if self.p99_ms is None else round(self.p99_ms, 3),
+            "shed_rate": round(self.shed_rate, 4),
+            "imgs_per_s": round(self.imgs_per_s, 2),
+            "queue_depth_max": self.queue_depth_max,
+            "utilization": round(self.utilization, 4),
+            "pad_ratio": round(self.pad_ratio, 4),
+            "flush_mix": dict(sorted(self.flush_mix.items())),
+        }
+
+
+@dataclasses.dataclass
+class _SimReq:
+    rows: int
+    t_arrive: float
+    deadline_t: float
+    client: Optional[int] = None  # closed-loop client id, else None
+
+
+def simulate(model: ServiceModel, knobs: SimKnobs,
+             arrivals: Optional[Sequence[Tuple[float, int]]] = None,
+             closed_concurrency: Optional[int] = None,
+             duration_s: Optional[float] = None) -> SimResult:
+    """Run one scenario: either an open/recorded ``arrivals`` list of
+    ``(t, rows)`` or ``closed_concurrency`` clients for ``duration_s``.
+    Virtual time, one seeded RNG — bit-deterministic."""
+    if (arrivals is None) == (closed_concurrency is None):
+        raise ValueError("exactly one of arrivals / closed_concurrency")
+    if closed_concurrency is not None and duration_s is None:
+        raise ValueError("closed-loop simulation needs duration_s")
+    planner = BucketPlanner(knobs.bucket_sizes)
+    cap = knobs.resolved_cap()
+    rng = random.Random(knobs.seed)
+    seq = itertools.count()
+    events: list = []  # (t, seq, kind, payload)
+
+    def push(t: float, kind: str, payload=None) -> None:
+        heapq.heappush(events, (t, next(seq), kind, payload))
+
+    if arrivals is not None:
+        load_end = max((t for t, _ in arrivals), default=0.0)
+        for t, rows in arrivals:
+            push(t, "arrival", _SimReq(rows, t, 0.0))
+    else:
+        load_end = float(duration_s)
+        for client in range(int(closed_concurrency)):
+            push(0.0, "arrival", _SimReq(1, 0.0, 0.0, client=client))
+    # closed-loop rejection retry pause: the live bench worker's
+    # submit→instant-reject→resubmit loop spins in sub-ms real time;
+    # virtual time needs an explicit (tiny) pause or it never advances
+    retry_s = max(1e-3, knobs.slo_s / 8.0)
+
+    pending: collections.deque = collections.deque()
+    pending_rows = 0
+    dispatch_q: collections.deque = collections.deque()
+    idle: List[int] = list(range(knobs.channels))
+    busy_s = 0.0
+    latencies: List[float] = []
+    flush_mix: Dict[str, int] = {}
+    submitted = completed = completed_rows = shed = 0
+    depth_max = 0
+    real_rows = pad_rows = 0
+    last_t = 0.0
+
+    def assign(now: float) -> None:
+        nonlocal busy_s, completed, completed_rows, real_rows, pad_rows
+        while idle and dispatch_q:
+            bucket, group = dispatch_q.popleft()
+            replica = idle.pop()
+            service = model.sample(bucket, rng)
+            done = now + service
+            busy_s += service
+            rows = sum(r.rows for r in group)
+            real_rows += rows
+            pad_rows += bucket - rows
+            for req in group:
+                latencies.append(done + model.overhead_s - req.t_arrive)
+                completed += 1
+                completed_rows += req.rows
+                if req.client is not None and done < load_end:
+                    push(done, "arrival",
+                         _SimReq(1, done, 0.0, client=req.client))
+            push(done, "free", replica)
+
+    def try_flush(now: float) -> None:
+        nonlocal pending_rows
+        assign(now)
+        while pending:
+            idle_now = bool(idle)
+            if not idle_now and len(dispatch_q) >= knobs.dispatch_buffer:
+                break  # placement backpressure: nothing to flush into
+            decision = policy.decide_flush(
+                planner, [r.rows for r in pending], pending[0].deadline_t,
+                pending_rows, now,
+                eager=knobs.eager and idle_now,
+            )
+            if decision is None:
+                break
+            group = [pending.popleft() for _ in range(decision.count)]
+            pending_rows -= decision.rows
+            flush_mix[decision.kind] = flush_mix.get(decision.kind, 0) + 1
+            dispatch_q.append((decision.bucket, group))
+            assign(now)
+
+    while events:
+        now, _, kind, payload = heapq.heappop(events)
+        last_t = max(last_t, now)
+        if kind == "arrival":
+            req: _SimReq = payload
+            submitted += 1
+            reason = policy.admit_decision(planner, pending_rows, req.rows,
+                                           cap)
+            if reason is not None:
+                shed += 1
+                if req.client is not None and now + retry_s < load_end:
+                    push(now + retry_s, "arrival",
+                         _SimReq(1, now + retry_s, 0.0, client=req.client))
+            else:
+                req.t_arrive = now
+                req.deadline_t = now + knobs.slo_s
+                pending.append(req)
+                pending_rows += req.rows
+                depth_max = max(depth_max, pending_rows)
+                push(req.deadline_t, "poll")
+            try_flush(now)
+        elif kind == "poll":
+            try_flush(now)
+        elif kind == "free":
+            idle.append(payload)
+            try_flush(now)
+
+    elapsed = max(last_t, load_end, 1e-9)
+    latencies.sort()
+    from distributedpytorch_tpu.obs.registry import nearest_rank
+
+    dispatched = real_rows + pad_rows
+    return SimResult(
+        submitted=submitted,
+        completed=completed,
+        completed_rows=completed_rows,
+        shed=shed,
+        duration_s=elapsed,
+        p50_ms=(nearest_rank(latencies, 50) * 1e3 if latencies else None),
+        p99_ms=(nearest_rank(latencies, 99) * 1e3 if latencies else None),
+        shed_rate=shed / submitted if submitted else 0.0,
+        imgs_per_s=completed_rows / elapsed,
+        queue_depth_max=depth_max,
+        utilization=busy_s / (knobs.channels * elapsed),
+        pad_ratio=pad_rows / dispatched if dispatched else 0.0,
+        flush_mix=flush_mix,
+    )

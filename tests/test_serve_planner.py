@@ -1,0 +1,705 @@
+"""plan-serve — the serve-tier capacity planner (ISSUE 14).
+
+Covers the whole subsystem, jax-free end to end:
+
+* the pure policy seam (serve/policy.py) the live queue AND the
+  simulator share — including proof the queue actually delegates;
+* the service-time model + discrete-event simulator (serve/sim.py):
+  determinism, underload/overload behavior, replica monotonicity,
+  arrival-trace recording/loading;
+* the profile staleness guard (obs/reqtrace.py): a profile measured on
+  a different bucket ladder or engine refuses loudly;
+* the ``dpt_serve_plan`` v1 artifact (analysis/serve_planner.py):
+  schema, planner-file idiom, and the BIT-IDENTICAL determinism pin;
+* the pinned replica recommendation on the checked-in smoke scenario
+  (the same artifacts the CI smoke replays);
+* the autoscale cross-check: the live hint's direction must agree with
+  the planner's recommendation on an obvious overload.
+"""
+
+import json
+import os
+import random
+import types
+
+import pytest
+
+from distributedpytorch_tpu.analysis import serve_planner as sp
+from distributedpytorch_tpu.obs.reqtrace import (
+    PROFILE_KIND,
+    PROFILE_VERSION,
+    ProfileMismatchError,
+    _BucketProfile,
+    engine_fingerprint,
+    load_profile,
+    save_profile,
+)
+from distributedpytorch_tpu.serve import policy, sim
+from distributedpytorch_tpu.serve.bucketing import BucketPlanner
+
+DATA = os.path.join(os.path.dirname(__file__), "data", "serve")
+SMOKE_PROFILE = os.path.join(DATA, "profile_smoke.json")
+SMOKE_TRACE = os.path.join(DATA, "arrivals_smoke.jsonl")
+
+#: Synthetic per-bucket device-exec times (ms) — capacity per service
+#: channel at bucket 8 is ~8 rows / 40 ms = 200 rows/s.
+SERVICE_MS = {1: 5.0, 2: 8.0, 4: 15.0, 8: 40.0}
+
+
+def make_profile(service_ms=None, ladder=(1, 2, 4, 8), slo_ms=25.0,
+                 **meta):
+    """A dpt_serve_profile v1 payload built through the REAL
+    accumulator (obs/reqtrace._BucketProfile) so the schema can't
+    drift from what bench_serve writes."""
+    buckets = {}
+    for b, ms in (service_ms or SERVICE_MS).items():
+        prof = _BucketProfile()
+        for _ in range(50):
+            prof.record(ms / 1e3, b, b, "full")
+        buckets[str(b)] = prof.payload()
+    payload = {
+        "kind": PROFILE_KIND, "version": PROFILE_VERSION,
+        "slo_ms": slo_ms,
+        "phase_medians_ms": {"decode": 0.2, "placement": 0.3,
+                             "drain": 0.2},
+        "buckets": buckets,
+        "bucket_sizes": list(ladder),
+    }
+    payload.update(meta)
+    return payload
+
+
+# ---------------------------------------------------------------------------
+class TestPolicySeam:
+    """serve/policy.py: the pure functions, and proof the live queue
+    delegates to them (the no-drift guarantee plan-serve rests on)."""
+
+    def setup_method(self):
+        self.planner = BucketPlanner((1, 2, 4, 8))
+
+    def test_full_flush_when_head_fills_largest_bucket(self):
+        d = policy.decide_flush(self.planner, [4, 4], 99.0, 8, now=0.0)
+        assert (d.kind, d.bucket, d.count, d.rows) == ("full", 8, 2, 8)
+
+    def test_full_flush_when_next_request_overflows(self):
+        # 6 rows + a 4-row request that doesn't fit: flush the 6 now
+        d = policy.decide_flush(self.planner, [6, 4], 99.0, 10, now=0.0)
+        assert (d.kind, d.bucket, d.count, d.rows) == ("full", 8, 1, 6)
+
+    def test_deadline_flush_covers_smallest_bucket(self):
+        d = policy.decide_flush(self.planner, [3], 1.0, 3, now=2.0)
+        assert (d.kind, d.bucket, d.count, d.rows) == ("deadline", 4, 1, 3)
+
+    def test_eager_flush_before_deadline(self):
+        assert policy.decide_flush(self.planner, [1], 9.0, 1, now=0.0) is None
+        d = policy.decide_flush(self.planner, [1], 9.0, 1, now=0.0,
+                                eager=True)
+        assert (d.kind, d.bucket) == ("eager", 1)
+
+    def test_shed_drops_to_largest_full_bucket(self):
+        # head group stops at 3 rows (the next 6-row request overflows
+        # the 8-bucket) with 24 rows backed up behind it: shed trims the
+        # flush to the largest bucket the head can FILL (2), no padding
+        sizes = [1, 1, 1, 6, 6, 6, 6]
+        d = policy.decide_flush(self.planner, sizes, 99.0, 27, now=0.0)
+        assert (d.kind, d.bucket, d.count, d.rows) == ("shed", 2, 2, 2)
+
+    def test_unsplittable_head_keeps_covering_bucket(self):
+        # a single 5-row head can't FILL any bucket <= 5: it rides its
+        # covering 8-bucket even under overload, padding and all
+        sizes = [5, 6, 6, 6]
+        d = policy.decide_flush(self.planner, sizes, 99.0, 23, now=0.0)
+        assert (d.kind, d.bucket, d.count, d.rows) == ("shed", 8, 1, 5)
+
+    def test_admit_decision(self):
+        assert policy.admit_decision(self.planner, 0, 9, 32) == \
+            policy.REJECT_TOO_LARGE
+        assert policy.admit_decision(self.planner, 30, 4, 32) == \
+            policy.REJECT_OVERLOAD
+        assert policy.admit_decision(self.planner, 28, 4, 32) is None
+
+    def _queue(self, clock):
+        from distributedpytorch_tpu.serve.queue import BatchingQueue
+
+        return BatchingQueue(self.planner, slo_s=0.05, clock=clock)
+
+    def _req(self, rows=1):
+        import numpy as np
+
+        from distributedpytorch_tpu.serve.queue import ServeRequest
+
+        return ServeRequest(images=[np.zeros((2, 2, 3), np.float32)] * rows)
+
+    def test_queue_delegates_flush_to_policy(self, monkeypatch):
+        """The live queue calls policy.decide_flush — patching the seam
+        changes queue behavior, so the two CANNOT drift."""
+        t = [0.0]
+        q = self._queue(lambda: t[0])
+        q.submit(self._req())
+        t[0] = 10.0  # way past the deadline
+        monkeypatch.setattr(policy, "decide_flush",
+                            lambda *a, **k: None)
+        assert q.poll() is None  # policy said no — queue obeys
+        monkeypatch.undo()
+        bucket, take = q.poll()
+        assert bucket == 1 and len(take) == 1
+
+    def test_queue_delegates_admission_to_policy(self, monkeypatch):
+        q = self._queue(lambda: 0.0)
+        monkeypatch.setattr(policy, "admit_decision",
+                            lambda *a, **k: policy.REJECT_OVERLOAD)
+        assert q.submit(self._req()) == policy.REJECT_OVERLOAD
+        assert q.rejected == 1
+
+    def test_queue_flush_matches_pure_policy_prediction(self):
+        """Shadow check: before every poll, the pure policy's decision
+        must predict exactly what the queue then does."""
+        t = [0.0]
+        q = self._queue(lambda: t[0])
+        script = [(0.0, 1), (0.001, 2), (0.002, 1), (0.06, 3)]
+        polls = [0.01, 0.055, 0.2]
+        it = iter(script)
+        pending_shadow = []
+        nxt = next(it, None)
+        for poll_t in polls:
+            while nxt is not None and nxt[0] <= poll_t:
+                t[0] = nxt[0]
+                assert q.submit(self._req(nxt[1])) is None
+                pending_shadow.append(
+                    (nxt[1], nxt[0] + q.slo_s)
+                )
+                nxt = next(it, None)
+            t[0] = poll_t
+            predicted = policy.decide_flush(
+                self.planner, [s for s, _ in pending_shadow],
+                pending_shadow[0][1] if pending_shadow else 0.0,
+                sum(s for s, _ in pending_shadow), poll_t,
+            )
+            got = q.poll()
+            if predicted is None:
+                assert got is None
+            else:
+                bucket, take = got
+                assert bucket == predicted.bucket
+                assert len(take) == predicted.count
+                del pending_shadow[:predicted.count]
+
+
+# ---------------------------------------------------------------------------
+class TestServiceModel:
+    def test_sampling_is_deterministic_and_bounded(self):
+        model = sim.ServiceModel(make_profile())
+        a = [model.sample(8, random.Random(3)) for _ in range(1)]
+        b = [model.sample(8, random.Random(3)) for _ in range(1)]
+        assert a == b
+        rng = random.Random(0)
+        for _ in range(200):
+            s = model.sample(8, rng)
+            # 40 ms observations land in the (25, 50] ms histogram
+            # segment; inverse-CDF samples stay inside it
+            assert 0.025 < s <= 0.050
+
+    def test_unprofiled_bucket_scales_and_notes(self):
+        model = sim.ServiceModel(make_profile({8: 40.0}))
+        s = model.sample(4, random.Random(0))
+        assert 0.0125 < s <= 0.025  # half of bucket 8's segment
+        assert any("bucket 4 unprofiled" in n for n in model.notes)
+        assert model.mean_service_s(4) == pytest.approx(
+            model.mean_service_s(8) / 2
+        )
+
+    def test_overhead_from_phase_medians(self):
+        model = sim.ServiceModel(make_profile())
+        assert model.overhead_s == pytest.approx(0.0007)
+
+    def test_empty_profile_refuses(self):
+        with pytest.raises(ValueError, match="no usable"):
+            sim.ServiceModel({"buckets": {}})
+
+    def test_capacity_counts_channels(self):
+        model = sim.ServiceModel(make_profile())
+        one = model.capacity_rows_per_s((1, 2, 4, 8), 1)
+        assert one == pytest.approx(200.0, rel=0.15)
+        assert model.capacity_rows_per_s((1, 2, 4, 8), 1, 2) == \
+            pytest.approx(2 * one)
+
+
+# ---------------------------------------------------------------------------
+class TestSimulator:
+    def setup_method(self):
+        self.model = sim.ServiceModel(make_profile())
+
+    def _knobs(self, **kw):
+        kw.setdefault("bucket_sizes", (1, 2, 4, 8))
+        kw.setdefault("slo_s", 0.025)
+        kw.setdefault("inflight_per_replica", 1)
+        return sim.SimKnobs(**kw)
+
+    def test_deterministic(self):
+        arr = sim.poisson_arrivals(300, 3.0, seed=5)
+        r1 = sim.simulate(self.model, self._knobs(replicas=1, seed=2),
+                          arrivals=arr)
+        r2 = sim.simulate(self.model, self._knobs(replicas=1, seed=2),
+                          arrivals=arr)
+        assert r1.payload() == r2.payload()
+
+    def test_underload_serves_everything(self):
+        arr = sim.poisson_arrivals(50, 3.0, seed=1)
+        r = sim.simulate(self.model, self._knobs(replicas=1), arrivals=arr)
+        assert r.shed == 0
+        assert r.completed == r.submitted == len(arr)
+        assert r.p99_ms is not None and r.p99_ms < 100.0
+
+    def test_overload_sheds_and_bounds_depth(self):
+        arr = sim.poisson_arrivals(600, 3.0, seed=1)
+        knobs = self._knobs(replicas=1)
+        r = sim.simulate(self.model, knobs, arrivals=arr)
+        assert r.shed_rate > 0.3  # offered 3x the ~200 rows/s capacity
+        assert r.queue_depth_max <= knobs.resolved_cap()
+        assert r.utilization > 0.9
+
+    def test_more_replicas_absorb_the_same_trace(self):
+        arr = sim.poisson_arrivals(600, 3.0, seed=1)
+        one = sim.simulate(self.model, self._knobs(replicas=1, seed=0),
+                           arrivals=arr)
+        four = sim.simulate(self.model, self._knobs(replicas=4, seed=0),
+                            arrivals=arr)
+        assert four.shed_rate < 0.02 < one.shed_rate
+        assert four.p99_ms < one.p99_ms
+
+    def test_inflight_channels_scale_throughput(self):
+        arr = sim.poisson_arrivals(600, 3.0, seed=1)
+        narrow = sim.simulate(
+            self.model, self._knobs(replicas=1, seed=0), arrivals=arr)
+        wide = sim.simulate(
+            self.model,
+            self._knobs(replicas=1, inflight_per_replica=2, seed=0),
+            arrivals=arr)
+        assert wide.imgs_per_s > narrow.imgs_per_s * 1.4
+
+    def test_closed_loop_is_self_clocked(self):
+        r = sim.simulate(self.model, self._knobs(replicas=1),
+                         closed_concurrency=4, duration_s=3.0)
+        assert r.shed == 0
+        assert r.completed > 100
+        assert r.p99_ms is not None
+
+    def test_non_eager_waits_for_deadline(self):
+        arr = [(0.0, 1)]
+        eager = sim.simulate(self.model, self._knobs(replicas=1),
+                             arrivals=arr)
+        lazy = sim.simulate(
+            self.model, self._knobs(replicas=1, eager=False),
+            arrivals=arr)
+        assert "eager" in eager.flush_mix
+        assert lazy.flush_mix == {"deadline": 1}
+        assert lazy.p99_ms > eager.p99_ms + 20.0  # waited out the SLO
+
+    def test_workload_argument_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            sim.simulate(self.model, self._knobs())
+        with pytest.raises(ValueError, match="exactly one"):
+            sim.simulate(self.model, self._knobs(), arrivals=[(0.0, 1)],
+                         closed_concurrency=2)
+        with pytest.raises(ValueError, match="duration_s"):
+            sim.simulate(self.model, self._knobs(), closed_concurrency=2)
+
+
+# ---------------------------------------------------------------------------
+class TestArrivalTrace:
+    def test_record_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "arr.jsonl")
+        rec = sim.ArrivalRecorder(path)
+        rec.record(100.5, 2, shape=(64, 96, 3), bucket=2)
+        rec.record(100.7, 1)
+        rec.close()
+        arrivals = sim.load_arrival_trace(path)
+        assert arrivals == [(0.0, 2), (pytest.approx(0.2), 1)]
+
+    def test_bounded_recording(self, tmp_path):
+        path = str(tmp_path / "arr.jsonl")
+        rec = sim.ArrivalRecorder(path, limit=3)
+        for i in range(10):
+            rec.record(float(i), 1)
+        rec.close()
+        assert rec.recorded == 3
+        assert len(sim.load_arrival_trace(path)) == 3
+
+    def test_missing_and_foreign_traces_are_none(self, tmp_path):
+        assert sim.load_arrival_trace(None) is None
+        assert sim.load_arrival_trace(str(tmp_path / "nope.jsonl")) is None
+        foreign = tmp_path / "foreign.jsonl"
+        foreign.write_text('{"kind": "something_else", "version": 1}\n')
+        assert sim.load_arrival_trace(str(foreign)) is None
+        empty = tmp_path / "empty.jsonl"
+        empty.write_text("")
+        assert sim.load_arrival_trace(str(empty)) is None
+
+    def test_torn_tail_line_is_skipped(self, tmp_path):
+        path = str(tmp_path / "arr.jsonl")
+        rec = sim.ArrivalRecorder(path)
+        rec.record(1.0, 1)
+        rec.record(2.0, 1)
+        rec.close()
+        with open(path, "a") as f:
+            f.write('{"t": 3.0, "rows"')  # crash mid-append
+        assert len(sim.load_arrival_trace(path)) == 2
+
+    def test_relaunched_recorder_appends_not_truncates(self, tmp_path):
+        """A supervised worker relaunched after a crash reuses its
+        --record-arrivals path: the pre-crash offered load must
+        survive (append), and the loader must skip the later
+        incarnation's would-be header."""
+        path = str(tmp_path / "arr.jsonl")
+        first = sim.ArrivalRecorder(path)
+        first.record(10.0, 1)
+        first.record(11.0, 2)
+        first.close()
+        second = sim.ArrivalRecorder(path)  # the relaunch
+        second.record(20.0, 1)
+        second.close()
+        arrivals = sim.load_arrival_trace(path)
+        assert arrivals == [(0.0, 1), (1.0, 2), (10.0, 1)]
+
+    def test_checked_in_smoke_trace_loads(self):
+        arrivals = sim.load_arrival_trace(SMOKE_TRACE)
+        assert arrivals is not None and len(arrivals) > 500
+        assert arrivals[0][0] == 0.0
+
+
+# ---------------------------------------------------------------------------
+class TestStalenessGuard:
+    def _saved(self, tmp_path, **meta):
+        path = str(tmp_path / "profile.json")
+        save_profile(make_profile(**meta), path)
+        return path
+
+    def test_matching_expectations_load(self, tmp_path):
+        fp = engine_fingerprint(model_arch="unet", image_size=(96, 64))
+        path = self._saved(tmp_path, engine_fingerprint=fp)
+        profile = load_profile(path, expect_buckets=(1, 2, 4, 8),
+                               expect_fingerprint=fp)
+        assert profile is not None
+
+    def test_ladder_mismatch_refuses_loudly(self, tmp_path):
+        path = self._saved(tmp_path)
+        with pytest.raises(ProfileMismatchError, match="bucket ladder"):
+            load_profile(path, expect_buckets=(1, 2, 4))
+
+    def test_fingerprint_mismatch_refuses_loudly(self, tmp_path):
+        fp = engine_fingerprint(model_arch="unet", image_size=(96, 64))
+        other = engine_fingerprint(model_arch="unet",
+                                   image_size=(96, 64), quantize="int8")
+        assert fp != other
+        path = self._saved(tmp_path, engine_fingerprint=fp)
+        with pytest.raises(ProfileMismatchError, match="engine"):
+            load_profile(path, expect_fingerprint=other)
+
+    def test_unverifiable_expectation_refuses(self, tmp_path):
+        """A profile with no recorded fingerprint cannot VERIFY a
+        fingerprint expectation — unverifiable must not pass."""
+        path = self._saved(tmp_path)
+        with pytest.raises(ProfileMismatchError, match="no engine"):
+            load_profile(path, expect_fingerprint="abc123")
+
+    def test_missing_and_corrupt_stay_none_with_note(self, tmp_path):
+        assert load_profile(str(tmp_path / "nope.json"),
+                            expect_buckets=(1, 2)) is None
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{not json")
+        assert load_profile(str(garbage), expect_buckets=(1, 2)) is None
+
+    def test_fingerprint_is_stable_and_identity_sensitive(self):
+        a = engine_fingerprint(model_arch="unet", image_size=(96, 64),
+                               model_widths=(8, 16))
+        b = engine_fingerprint(model_arch="unet", image_size=(96, 64),
+                               model_widths=(8, 16))
+        assert a == b
+        assert a != engine_fingerprint(model_arch="milesial",
+                                       image_size=(96, 64),
+                                       model_widths=(8, 16))
+        assert a != engine_fingerprint(model_arch="unet",
+                                       image_size=(128, 64),
+                                       model_widths=(8, 16))
+
+
+# ---------------------------------------------------------------------------
+def _scenario(rate=600.0, duration=2.0, label=None, seed=9):
+    label = label or f"poisson:{rate:g}rps"
+    return {
+        "label": label, "kind": "poisson", "rate_rps": rate,
+        "arrivals": sim.poisson_arrivals(rate, duration, seed=seed),
+    }
+
+
+class TestPlanArtifact:
+    def _plan(self, **kw):
+        kw.setdefault("bucket_ladders", [(1, 2, 4, 8)])
+        kw.setdefault("slos_ms", [25.0])
+        kw.setdefault("replicas", (1, 2))
+        kw.setdefault("duration_s", 2.0)
+        return sp.build_serve_plan(make_profile(), [_scenario()], **kw)
+
+    def test_schema_and_grid_coverage(self):
+        plan = self._plan()
+        assert plan["kind"] == sp.SERVE_PLAN_KIND
+        assert plan["version"] == sp.SERVE_PLAN_VERSION
+        assert len(plan["points"]) == 2  # 1 scenario x 1 ladder x 2 R
+        for point in plan["points"]:
+            assert set(point) >= {"key", "scenario", "replicas",
+                                  "predicted", "slo_ok"}
+            pred = point["predicted"]
+            assert set(pred) >= {"p50_ms", "p99_ms", "shed_rate",
+                                 "queue_depth_max", "imgs_per_s",
+                                 "utilization"}
+        assert len(plan["recommendations"]) == 1
+        # scenarios are embedded WITHOUT their arrival lists (the plan
+        # references traffic, it doesn't re-record it)
+        assert "arrivals" not in plan["scenarios"][0]
+
+    def test_save_load_roundtrip_and_idiom(self, tmp_path):
+        plan = self._plan()
+        path = str(tmp_path / "plan.json")
+        sp.save_serve_plan(plan, path)
+        assert sp.load_serve_plan(path) == plan
+        assert sp.load_serve_plan(None) is None
+        assert sp.load_serve_plan(str(tmp_path / "nope.json")) is None
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("{broken")
+        assert sp.load_serve_plan(str(garbage)) is None
+        foreign = tmp_path / "foreign.json"
+        foreign.write_text(json.dumps({"kind": "dpt_plan", "version": 1,
+                                       "points": []}))
+        assert sp.load_serve_plan(str(foreign)) is None
+
+    def test_bit_identical_artifact(self, tmp_path):
+        """THE determinism pin: same profile + trace + seed -> the same
+        plan file, byte for byte."""
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        sp.save_serve_plan(self._plan(seed=7), a)
+        sp.save_serve_plan(self._plan(seed=7), b)
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read()
+        # and a different seed produces a different simulation
+        assert sp.build_serve_plan(
+            make_profile(), [_scenario()], bucket_ladders=[(1, 2, 4, 8)],
+            slos_ms=[25.0], replicas=(1, 2), duration_s=2.0, seed=8,
+        )["points"] != self._plan(seed=7)["points"]
+
+    def test_point_key_format_is_stable(self):
+        # bench_serve stamps these into leg rows (plan_point
+        # provenance) — the format is load-bearing
+        assert sp.point_key("poisson:600rps", (1, 2, 4, 8), 25.0, 2,
+                            True, None) == \
+            "poisson:600rps/b1x2x4x8/slo25/r2/eager/capauto"
+        assert sp.point_key("t", (1, 2), 12.5, 1, False, 16) == \
+            "t/b1x2/slo12.5/r1/noeager/cap16"
+
+    def test_what_if_ladder_rides_with_notes(self):
+        plan = self._plan(bucket_ladders=[(1, 2, 4, 8), (1, 2, 16)])
+        assert len(plan["points"]) == 4
+        assert any("16 unprofiled" in n
+                   for n in plan["service_model_notes"])
+
+
+# ---------------------------------------------------------------------------
+class TestRecommendationPin:
+    """The ISSUE acceptance pin: on the checked-in smoke scenario
+    (600 rows/s against the synthetic ~400 rows/s one-replica serving
+    capacity) one replica overloads and two hold the SLO — the planner
+    must recommend exactly 2, deterministically."""
+
+    def _plan(self):
+        profile = load_profile(SMOKE_PROFILE)
+        assert profile is not None
+        arrivals = sim.load_arrival_trace(SMOKE_TRACE)
+        assert arrivals is not None
+        scenario = {"label": "smoke", "kind": "trace",
+                    "path": SMOKE_TRACE, "arrivals": arrivals}
+        return sp.build_serve_plan(
+            profile, [scenario],
+            bucket_ladders=[profile["bucket_sizes"]],
+            slos_ms=[profile["slo_ms"]],
+            replicas=(1, 2, 4),
+            seed=0,
+            profile_path=SMOKE_PROFILE,
+        )
+
+    def test_replica_recommendation_is_two(self):
+        plan = self._plan()
+        rec = plan["recommendations"][0]
+        assert rec["replicas"] == 2
+        by_r = {p["replicas"]: p for p in plan["points"]}
+        assert not by_r[1]["slo_ok"]  # the obvious overload
+        assert by_r[1]["predicted"]["shed_rate"] > 0.1
+        assert by_r[2]["slo_ok"] and by_r[4]["slo_ok"]
+
+    def test_pin_is_deterministic(self):
+        assert self._plan() == self._plan()
+
+    def test_profile_provenance_recorded(self):
+        plan = self._plan()
+        assert plan["profile"]["path"] == SMOKE_PROFILE
+        assert plan["profile"]["bucket_sizes"] == [1, 2, 4, 8]
+        assert plan["profile"]["engine_fingerprint"]
+
+
+# ---------------------------------------------------------------------------
+class TestAutoscaleCrossCheck:
+    """serve/autoscale.py's hint is the planner's runtime shadow: on
+    one deterministic overload, the offline recommendation (more
+    replicas) and the live hint's hysteresis (scale up after
+    ``up_windows`` pressured windows) must agree on direction."""
+
+    def test_hint_and_plan_agree_on_obvious_overload(self):
+        from distributedpytorch_tpu.serve.autoscale import AutoscaleHint
+
+        profile = load_profile(SMOKE_PROFILE)
+        arrivals = sim.load_arrival_trace(SMOKE_TRACE)
+        serving_replicas = 1
+        result = sim.simulate(
+            sim.ServiceModel(profile),
+            sim.SimKnobs(bucket_sizes=(1, 2, 4, 8), slo_s=0.025,
+                         replicas=serving_replicas, seed=0),
+            arrivals=arrivals,
+        )
+        assert result.shed > 0  # the planner-side overload verdict
+        plan = sp.build_serve_plan(
+            profile,
+            [{"label": "smoke", "kind": "trace", "arrivals": arrivals}],
+            bucket_ladders=[(1, 2, 4, 8)], slos_ms=[25.0],
+            replicas=(1, 2, 4), seed=0,
+        )
+        plan_replicas = plan["recommendations"][0]["replicas"]
+        assert plan_replicas > serving_replicas
+
+        # the live hint, fed the SAME pressure the simulation derived
+        # (shed per window, depth at the cap): after up_windows
+        # pressured windows it recommends scaling up — same direction
+        fake = types.SimpleNamespace(
+            engine=types.SimpleNamespace(
+                planner=types.SimpleNamespace(max_size=8),
+                num_replicas=serving_replicas,
+            ),
+        )
+        hint = AutoscaleHint(fake, interval_s=999.0, up_windows=2)
+        hint.observe_window(shed_delta=result.shed // 2,
+                            max_depth=result.queue_depth_max)
+        hint_replicas = hint.observe_window(
+            shed_delta=result.shed // 2,
+            max_depth=result.queue_depth_max,
+        )
+        assert hint_replicas > serving_replicas
+        # hysteresis is the documented difference: the hint moves ONE
+        # step per sustained window, the planner jumps straight to the
+        # feasible count
+        assert hint_replicas == serving_replicas + 1
+        assert plan_replicas >= hint_replicas
+
+
+# ---------------------------------------------------------------------------
+class TestPlanServeCLI:
+    def test_writes_loadable_plan_from_smoke_artifacts(self, tmp_path):
+        out = str(tmp_path / "plan.json")
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", SMOKE_TRACE,
+                      "--replicas", "1", "2", "--out", out])
+        assert rc == 0
+        plan = sp.load_serve_plan(out)
+        assert plan is not None
+        assert plan["points"]
+        assert plan["recommendations"][0]["replicas"] == 2
+
+    def test_cli_is_bit_deterministic(self, tmp_path):
+        a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+        argv = ["--profile", SMOKE_PROFILE, "--trace", SMOKE_TRACE,
+                "--replicas", "1", "2"]
+        assert sp.main(argv + ["--out", a]) == 0
+        assert sp.main(argv + ["--out", b]) == 0
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert fa.read() == fb.read()
+
+    def test_ladder_mismatch_exits_loudly(self, tmp_path):
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", SMOKE_TRACE,
+                      "--buckets", "1", "2", "4",
+                      "--out", str(tmp_path / "p.json")])
+        assert rc == 2
+
+    def test_fingerprint_mismatch_exits_loudly(self, tmp_path):
+        # the smoke profile fingerprints as unet@96x64 widths (8, 16);
+        # planning for an int8 deployment must refuse
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", SMOKE_TRACE,
+                      "--model", "unet", "--image-size", "96", "64",
+                      "--model-widths", "8", "16", "--s2d-levels", "0",
+                      "--quantize", "int8",
+                      "--out", str(tmp_path / "p.json")])
+        assert rc == 2
+
+    def test_matching_fingerprint_plans(self, tmp_path):
+        out = str(tmp_path / "p.json")
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", SMOKE_TRACE,
+                      "--model", "unet", "--image-size", "96", "64",
+                      "--model-widths", "8", "16", "--s2d-levels", "0",
+                      "--replicas", "1", "2", "--out", out])
+        assert rc == 0 and sp.load_serve_plan(out) is not None
+
+    def test_duplicate_trace_basenames_get_distinct_labels(self,
+                                                           tmp_path):
+        """Two --trace files sharing a basename must not share a
+        scenario label — the recommendation groups points by label, and
+        a collision would merge two traffic patterns into one."""
+        import shutil
+
+        (tmp_path / "a").mkdir()
+        (tmp_path / "b").mkdir()
+        shutil.copy(SMOKE_TRACE, tmp_path / "a" / "arrivals.jsonl")
+        shutil.copy(SMOKE_TRACE, tmp_path / "b" / "arrivals.jsonl")
+        out = str(tmp_path / "plan.json")
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", str(tmp_path / "a" / "arrivals.jsonl"),
+                      "--trace", str(tmp_path / "b" / "arrivals.jsonl"),
+                      "--replicas", "1", "--out", out])
+        assert rc == 0
+        plan = sp.load_serve_plan(out)
+        labels = [s["label"] for s in plan["scenarios"]]
+        assert len(set(labels)) == 2, labels
+        assert len(plan["recommendations"]) == 2
+
+    def test_missing_profile_exits_loudly(self, tmp_path):
+        rc = sp.main(["--profile", str(tmp_path / "nope.json"),
+                      "--out", str(tmp_path / "p.json")])
+        assert rc == 2
+
+    def test_no_scenarios_exits_loudly(self, tmp_path):
+        # --rates [] can't be expressed; an unreadable trace is the
+        # no-usable-scenario path
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("not a trace\n")
+        rc = sp.main(["--profile", SMOKE_PROFILE,
+                      "--trace", str(bad),
+                      "--out", str(tmp_path / "p.json")])
+        assert rc == 2
+
+    def test_default_rate_ladder_from_profile_capacity(self, tmp_path):
+        out = str(tmp_path / "p.json")
+        rc = sp.main(["--profile", SMOKE_PROFILE, "--duration", "2",
+                      "--replicas", "1", "--out", out])
+        assert rc == 0
+        plan = sp.load_serve_plan(out)
+        assert len(plan["scenarios"]) == len(sp.DEFAULT_RATE_FRACTIONS)
+
+    def test_module_subcommand_dispatch(self):
+        import subprocess
+        import sys
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "distributedpytorch_tpu",
+             "plan-serve", "--help"],
+            capture_output=True, text=True, timeout=60,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert proc.returncode == 0
+        assert "plan-serve" in proc.stdout

@@ -31,6 +31,17 @@ per-bucket service-time profiles, so bench legs double as calibration
 runs for the serve capacity planner (``report["profile"]`` names the
 in-SLO leg's — the regime a plan should calibrate from).
 
+The closed/open/overload legs go one step further and CLOSE the
+plan-serve loop on themselves: each records its own arrival trace
+(``dpt_serve_arrivals`` JSONL — the serve front's ``--record-arrivals``
+format), then replays that trace against its own profile in the
+discrete-event simulator (serve/sim.py) and stamps a ``validation``
+block comparing predicted p99 / shed-rate against the measured row,
+plus the ``plan_point`` grid key the leg validates (bench_multi's
+plan-provenance pattern). Tier-1 asserts the tolerance on the
+CPU-pinned legs — the simulator must reproduce the bench from traces
+alone, or capacity plans built on it are fiction.
+
 Usage:
     python tools/bench_serve.py --levels 1 4 16 --duration 5 \\
         --out serve_report.json
@@ -103,16 +114,36 @@ def make_images(n: int, hw, seed: int = 0) -> np.ndarray:
     return rng.random((n, hw[0], hw[1], 3), dtype=np.float32)
 
 
-def _new_server(engine, args):
+def _new_server(engine, args, record_leg: Optional[str] = None):
     from distributedpytorch_tpu.serve.server import Server
 
-    return Server(
+    server = Server(
         engine,
         slo_ms=args.slo_ms,
         hard_cap_images=args.queue_cap,
         placement_depth=args.placement_depth,
         eager_when_idle=not args.no_eager,
     ).start()
+    if record_leg is not None:
+        # per-leg arrival trace (the serve front's --record-arrivals
+        # format): the validation step replays it through the simulator
+        from distributedpytorch_tpu.serve.sim import ArrivalRecorder
+
+        server.arrival_recorder = ArrivalRecorder(
+            _arrivals_path(args, record_leg)
+        )
+    return server
+
+
+def _engine_fingerprint(args) -> str:
+    from distributedpytorch_tpu.obs.reqtrace import engine_fingerprint
+
+    return engine_fingerprint(
+        model_arch=args.model_arch,
+        image_size=tuple(args.image_size),
+        model_widths=tuple(args.model_widths) if args.model_widths else None,
+        s2d_levels=args.s2d_levels,
+    )
 
 
 def _leg_calibration(server, args, leg: str) -> dict:
@@ -121,7 +152,8 @@ def _leg_calibration(server, args, leg: str) -> dict:
     WHERE this leg's latency went) and the ``dpt_serve_profile`` v1
     artifact written from this leg's per-bucket service-time profiles,
     so every bench leg doubles as a calibration run for the serve
-    capacity planner (ROADMAP plan-serve)."""
+    capacity planner (``plan-serve``). The profile carries the bucket
+    ladder and engine fingerprint the staleness guard cross-checks."""
     from distributedpytorch_tpu.obs.reqtrace import save_profile
 
     medians = server.tracer.phase_medians_ms()
@@ -132,10 +164,12 @@ def _leg_calibration(server, args, leg: str) -> dict:
         bucket_sizes=list(args.buckets),
         replicas=server.engine.num_replicas,
         eager_when_idle=not args.no_eager,
+        queue_cap_images=server.queue.hard_cap_images,
+        engine_fingerprint=_engine_fingerprint(args),
     )
     path = _artifact_path(args, f"profile_{leg}")
     save_profile(payload, path)
-    return {
+    out = {
         "attribution": {
             "queue_wait_ms": medians.get("queue_wait"),
             "placement_ms": medians.get("placement"),
@@ -145,13 +179,98 @@ def _leg_calibration(server, args, leg: str) -> dict:
         },
         "profile": path,
     }
+    recorder = server.arrival_recorder
+    if recorder is not None:
+        recorder.close()
+        out["arrivals"] = recorder.path
+    return out
+
+
+#: Stated predicted-vs-measured tolerances (the validation contract
+#: tier-1 asserts on the CPU-pinned legs): p99 within a 4x factor with
+#: a 25 ms floor (CI-container scheduling jitter dominates small
+#: absolute values), shed rate within 0.2 absolute (the structural
+#: cap-bound number, which the simulator should land close to).
+VALIDATION_P99_FACTOR = 4.0
+VALIDATION_P99_FLOOR_MS = 25.0
+VALIDATION_SHED_ABS = 0.2
+
+
+def _leg_validation(server, args, row: dict, leg: str) -> None:
+    """Close the plan-serve loop on this leg: replay its own recorded
+    arrivals against its own profile in the discrete-event simulator
+    and stamp predicted-vs-measured p99 / shed-rate (with the stated
+    tolerance verdict) plus the ``plan_point`` key the leg validates."""
+    from distributedpytorch_tpu.analysis.serve_planner import point_key
+    from distributedpytorch_tpu.obs.reqtrace import load_profile
+    from distributedpytorch_tpu.serve import sim
+
+    cap = server.queue.hard_cap_images
+    row["plan_point"] = point_key(
+        f"replay-{leg}", tuple(args.buckets), args.slo_ms,
+        server.engine.num_replicas, not args.no_eager, cap,
+    )
+    profile = load_profile(row.get("profile"))
+    arrivals = sim.load_arrival_trace(row.get("arrivals"))
+    if profile is None or arrivals is None:
+        row["validation"] = {"ok": None,
+                             "note": "no profile/arrivals to replay"}
+        return
+    try:
+        model = sim.ServiceModel(profile)
+    except ValueError as exc:
+        row["validation"] = {"ok": None, "note": str(exc)}
+        return
+    knobs = sim.SimKnobs(
+        bucket_sizes=tuple(args.buckets),
+        slo_s=args.slo_ms / 1e3,
+        replicas=server.engine.num_replicas,
+        eager=not args.no_eager,
+        hard_cap_images=cap,
+        # the sim's flushed-group buffer mirrors the leg's ACTUAL
+        # placement depth (>=1: even synchronous placement holds the
+        # one group the dispatch loop has in hand)
+        dispatch_buffer=max(1, args.placement_depth),
+        seed=args.seed,
+    )
+    predicted = sim.simulate(model, knobs, arrivals=arrivals).payload()
+    snap = server.metrics.snapshot()
+    measured_p99 = row.get("p99_ms")
+    submitted = snap["requests_ok"] + snap["rejected_total"]
+    measured_shed = (
+        snap["rejected"].get("overloaded", 0) / submitted if submitted else 0.0
+    )
+    p99_ok = None
+    if measured_p99 is not None and predicted["p99_ms"] is not None:
+        floor = VALIDATION_P99_FLOOR_MS
+        p99_ok = (
+            predicted["p99_ms"]
+            <= measured_p99 * VALIDATION_P99_FACTOR + floor
+            and measured_p99
+            <= predicted["p99_ms"] * VALIDATION_P99_FACTOR + floor
+        )
+    shed_ok = abs(predicted["shed_rate"] - measured_shed) <= VALIDATION_SHED_ABS
+    row["validation"] = {
+        "predicted_p99_ms": predicted["p99_ms"],
+        "measured_p99_ms": measured_p99,
+        "predicted_shed_rate": predicted["shed_rate"],
+        "measured_shed_rate": round(measured_shed, 4),
+        "predicted_imgs_per_s": predicted["imgs_per_s"],
+        "tolerance": {
+            "p99_factor": VALIDATION_P99_FACTOR,
+            "p99_floor_ms": VALIDATION_P99_FLOOR_MS,
+            "shed_abs": VALIDATION_SHED_ABS,
+        },
+        "ok": bool(p99_ok) and shed_ok if p99_ok is not None else None,
+    }
 
 
 def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
     """C workers, submit→wait→repeat for ``duration_s``. A fresh Server
     per level (the compiled engine is reused) keeps each level's metrics
     and queue counters isolated."""
-    server = _new_server(engine, args)
+    leg = f"closed_c{concurrency}"
+    server = _new_server(engine, args, record_leg=leg)
     images = make_images(max(2 * concurrency, 16), engine.input_hw, args.seed)
     stop_at = time.monotonic() + duration_s
     errors: List[str] = []
@@ -189,7 +308,8 @@ def closed_loop(engine, args, concurrency: int, duration_s: float) -> dict:
         "bucket_dispatches": snap["bucket_dispatches"],
         "errors": errors[:3],
     }
-    row.update(_leg_calibration(server, args, f"closed_c{concurrency}"))
+    row.update(_leg_calibration(server, args, leg))
+    _leg_validation(server, args, row, leg)
     return row
 
 
@@ -199,7 +319,7 @@ def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
     cover ACCEPTED requests; rejections are counted, not averaged in —
     under overload the interesting numbers are (a) bounded depth and
     (b) how much got shed, separately."""
-    server = _new_server(engine, args)
+    server = _new_server(engine, args, record_leg=label)
     images = make_images(32, engine.input_hw, args.seed)
     period = 1.0 / max(rate_imgs_per_s, 1e-9)
     futures = []
@@ -249,6 +369,7 @@ def open_loop(engine, args, rate_imgs_per_s: float, duration_s: float,
         "pad_ratio": snap["pad_ratio"],
     }
     row.update(_leg_calibration(server, args, label))
+    _leg_validation(server, args, row, label)
     return row
 
 
@@ -266,6 +387,16 @@ def _flight_path(args, leg: str) -> str:
     """Per-leg flight-recorder artifact path (bench_multi's session rows
     reference these for post-mortems)."""
     return _artifact_path(args, f"flight_{leg}")
+
+
+def _arrivals_path(args, leg: str) -> str:
+    """Per-leg recorded arrival-trace path (dpt_serve_arrivals JSONL)."""
+    import tempfile
+
+    if args.out:
+        return f"{args.out}.arrivals_{leg}.jsonl"
+    return os.path.join(tempfile.gettempdir(),
+                        f"bench_serve_arrivals_{leg}.jsonl")
 
 
 def chaos_leg(engine, args, duration_s: float) -> dict:
