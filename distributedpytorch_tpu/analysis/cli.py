@@ -62,10 +62,19 @@ def build_parser() -> argparse.ArgumentParser:
                     default=list(ANALYSIS_STRATEGIES),
                     help="Strategies to trace (default: all analyzed "
                          "strategies)")
+    ap.add_argument("--mesh", nargs="+", default=[], metavar="SPEC",
+                    help="Mesh-config specs (DxMxS[@fsdp|sp], parallel/"
+                         "mesh.py) to analyze IN ADDITION to "
+                         "--strategies — the preflight surface for "
+                         "``-t 4x1x2``-style mesh launches; specs with "
+                         "a stage axis trace both --schedules and their "
+                         "comms contract derives from the sharding "
+                         "rules")
     ap.add_argument("--schedules", nargs="+",
                     default=list(ANALYSIS_SCHEDULES),
                     choices=["gpipe", "1f1b"],
-                    help="Pipeline schedules for MP/DDP_MP combos")
+                    help="Pipeline schedules for MP/DDP_MP (and "
+                         "stage-axis mesh spec) combos")
     ap.add_argument("--layer", choices=["all", "collectives", "lint"],
                     default="all", help="Which analysis layer(s) to run")
     ap.add_argument("--hlo", action="store_true",
@@ -114,9 +123,27 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     try:
         if args.layer in ("all", "collectives"):
             from distributedpytorch_tpu.analysis import collectives
+            from distributedpytorch_tpu.parallel.mesh import parse_mesh_spec
 
+            for spec in args.mesh:
+                try:
+                    parse_mesh_spec(spec)  # refuse malformed specs loudly
+                except ValueError as exc:
+                    # bad invocation, caught BEFORE any combo traces —
+                    # a clear message, and no other combo's findings
+                    # are ever at stake (unbuildable-but-parseable
+                    # specs degrade per combo to a mesh-config finding
+                    # inside analyze_combo)
+                    print(f"analyze: --mesh {exc}", file=sys.stderr)
+                    return EXIT_INFRA
+            # order-preserving dedup across (and within) both lists: a
+            # repeated method must not trace (and fingerprint) twice —
+            # the planner gets this for free from its point de-dup
+            strategies = list(
+                dict.fromkeys(list(args.strategies) + list(args.mesh))
+            )
             cfindings, combos = collectives.analyze(
-                strategies=args.strategies,
+                strategies=strategies,
                 schedules=args.schedules,
                 hlo=args.hlo,
                 rank_check=not args.no_rank_check,
@@ -124,7 +151,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
             findings += cfindings
             if args.fingerprint_world >= 2:
                 ffindings, fingerprints = collectives.fingerprint_combos(
-                    strategies=args.strategies,
+                    strategies=strategies,
                     schedules=args.schedules,
                     world=args.fingerprint_world,
                 )

@@ -69,6 +69,18 @@ from distributedpytorch_tpu.analysis import (
     Finding,
     dedupe,
 )
+# the mesh rule engine (jax-free module): contracts DERIVE from the
+# sharding rules instead of a hand-kept table, and ``DxMxS[@rule]``
+# mesh specs analyze exactly like the legacy strategy names
+from distributedpytorch_tpu.parallel.mesh import (
+    LEGACY_PATTERNS,
+    channel_comms_required,
+    derive_hlo_contract,
+    derive_jaxpr_contract,
+    is_mesh_spec,
+    parse_mesh_spec,
+    spec_is_pipeline,
+)
 
 # -- the tiny analysis rig ---------------------------------------------------
 # Same shapes as tests/test_strategies.py's equivalence rig: the analyzer
@@ -91,18 +103,21 @@ COLLECTIVE_PRIMS = frozenset(
 
 # -- the declared comms contract (check d) -----------------------------------
 #: Optimized-HLO collectives each strategy's compiled train step must
-#: contain (verified against XLA's output on the 8-device CPU mesh).
-#: This is the single source tests/test_hlo_collectives.py imports; the
-#: test keeps its own independent regex over compiled.as_text().
+#: contain (verified against XLA's output on the 8-device CPU mesh),
+#: DERIVED from each strategy's mesh pattern by the sharding-rule engine
+#: (parallel/mesh.derive_hlo_contract) — DP's gradient all-reduce, SP's
+#: conv halo collective-permutes, FSDP's ZeRO all-gathers, MP/DDP_MP's
+#: ppermute stage transfers. This is the single source
+#: tests/test_hlo_collectives.py imports; the test keeps its own
+#: independent regex over compiled.as_text().
 EXPECTED_HLO_COLLECTIVES: Dict[str, FrozenSet[str]] = {
-    "DP": frozenset({"all-reduce"}),            # gradient reduction
-    "SP": frozenset({"collective-permute"}),    # conv halo exchanges
-    "FSDP": frozenset({"all-gather"}),          # ZeRO param gathering
-    "MP": frozenset({"collective-permute"}),    # ppermute stage transfers
-    "DDP_MP": frozenset({"collective-permute", "all-reduce"}),
+    method: derive_hlo_contract(LEGACY_PATTERNS[method])
+    for method in ("DP", "SP", "FSDP", "MP", "DDP_MP")
 }
 #: TP's sharded-channel layers must communicate somehow; XLA picks the
 #: mechanism per version — any of these proves channels are distributed.
+#: (mesh.channel_comms_required marks the configs this tier applies to;
+#: for channel HYBRIDS it applies IN ADDITION to the derived exact set.)
 TP_HLO_ANY_OF = frozenset({"all-to-all", "all-gather", "collective-permute"})
 
 
@@ -120,45 +135,45 @@ class JaxprComm:
     why: str = ""
 
 
-#: Trace-level contract per (strategy, schedule). GSPMD strategies have
-#: no jaxpr-visible collectives — their row is empty and their contract
-#: lives in EXPECTED_HLO_COLLECTIVES.
-JAXPR_CONTRACTS: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = {
-    ("DP", None): (),
-    ("SP", None): (),
-    ("TP", None): (),
-    ("FSDP", None): (),
-    ("MP", "gpipe"): (
-        JaxprComm("ppermute", frozenset({"stage"}),
-                  why="inter-stage activation transfers"),
-        JaxprComm("psum", frozenset({"stage"}),
-                  why="whole-batch loss-stats reduction"),
-    ),
-    ("MP", "1f1b"): (
-        JaxprComm("ppermute", frozenset({"stage"}),
-                  why="inter-stage activation/cotangent transfers"),
-        JaxprComm("psum", frozenset({"stage"}),
-                  why="whole-batch loss-stats reduction"),
-        JaxprComm("psum", frozenset({"stage"}), grad_output=True,
-                  why="schedule-closing gradient assembly across stages"),
-    ),
-    ("DDP_MP", "gpipe"): (
-        JaxprComm("ppermute", frozenset({"stage"}),
-                  why="inter-stage activation transfers"),
-        JaxprComm("psum", frozenset({"stage", "data"}),
-                  why="whole-batch loss-stats reduction across stages "
-                      "AND data shards"),
-    ),
-    ("DDP_MP", "1f1b"): (
-        JaxprComm("ppermute", frozenset({"stage"}),
-                  why="inter-stage activation/cotangent transfers"),
-        JaxprComm("psum", frozenset({"stage", "data"}),
-                  why="whole-batch loss-stats reduction"),
-        JaxprComm("psum", frozenset({"stage", "data"}), grad_output=True,
-                  why="schedule-closing gradient psum — the 'data' axis "
-                      "IS the DDP all-reduce"),
-    ),
-}
+def _derived_contract(pattern, schedule) -> Tuple[JaxprComm, ...]:
+    """Wrap the rule engine's derived rows into JaxprComm requirements
+    (the row tuples are JaxprComm's field order by construction)."""
+    return tuple(
+        JaxprComm(kind, axes, grad_output, why)
+        for kind, axes, grad_output, why in derive_jaxpr_contract(
+            pattern, schedule
+        )
+    )
+
+
+def _build_contract_table() -> Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]]:
+    table: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = {}
+    for method in ANALYSIS_STRATEGIES:
+        pattern = LEGACY_PATTERNS[method]
+        if pattern.is_pipeline:
+            for schedule in ANALYSIS_SCHEDULES:
+                table[(method, schedule)] = _derived_contract(
+                    pattern, schedule
+                )
+        else:
+            table[(method, None)] = _derived_contract(pattern, None)
+    return table
+
+
+#: Trace-level contract per (strategy, schedule), DERIVED from each
+#: strategy's mesh pattern by the sharding-rule engine
+#: (parallel/mesh.derive_jaxpr_contract) instead of a hand-kept table:
+#: pipelined patterns require the inter-stage ppermutes, the whole-batch
+#: stats psum over ('stage'[, 'data']), and (1f1b) the schedule-closing
+#: output-feeding gradient psum whose 'data' axis IS the DDP all-reduce
+#: for DDP_MP — dropping it would silently fork the data replicas.
+#: GSPMD strategies derive EMPTY rows (XLA inserts their collectives at
+#: compile time) — their contract lives in EXPECTED_HLO_COLLECTIVES.
+#: Mesh-spec methods (``4x1x2``) don't need a row here: check_contract
+#: derives theirs on the fly from the parsed spec.
+JAXPR_CONTRACTS: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = (
+    _build_contract_table()
+)
 
 
 # -- extraction --------------------------------------------------------------
@@ -368,12 +383,24 @@ def _require_devices(n: int) -> None:
         )
 
 
+def _rig_batch(method: str) -> int:
+    """The analysis rig's batch for one method: B, rounded UP to the
+    nearest multiple a mesh spec's data axis (x microbatches, when
+    pipelined) requires — odd geometries like ``3x1x2`` must trace,
+    not refuse on the rig's own batch choice."""
+    if not is_mesh_spec(method):
+        return B
+    cfg = parse_mesh_spec(method)
+    unit = max(cfg.data, 1) * (2 if cfg.stage > 1 else 1)
+    return ((B + unit - 1) // unit) * unit
+
+
 def _tiny_config(method: str, schedule: Optional[str]):
     from distributedpytorch_tpu.config import TrainConfig
 
     return TrainConfig(
         train_method=method,
-        batch_size=B,
+        batch_size=_rig_batch(method),
         compute_dtype="float32",
         image_size=(W, H),
         model_widths=WIDTHS,
@@ -392,7 +419,10 @@ def _build(method: str, schedule: Optional[str]):
     from distributedpytorch_tpu.parallel import build_strategy
     from distributedpytorch_tpu.train.steps import TrainState
 
-    _require_devices(8 if method in ("DDP_MP", "DDP_SP") else 2)
+    if is_mesh_spec(method):
+        _require_devices(parse_mesh_spec(method).size)
+    else:
+        _require_devices(8 if method in ("DDP_MP", "DDP_SP") else 2)
     cfg = _tiny_config(method, schedule)
     strategy = build_strategy(cfg)
     model = UNet(dtype=jnp.float32, widths=WIDTHS)
@@ -408,9 +438,10 @@ def _build(method: str, schedule: Optional[str]):
         step=jax.ShapeDtypeStruct((), jnp.int32),
         model_state=None,
     )
+    nb = _rig_batch(method)
     batch = {
-        "image": jax.ShapeDtypeStruct((B, H, W, 3), jnp.float32),
-        "mask": jax.ShapeDtypeStruct((B, H, W), jnp.int32),
+        "image": jax.ShapeDtypeStruct((nb, H, W, 3), jnp.float32),
+        "mask": jax.ShapeDtypeStruct((nb, H, W), jnp.int32),
     }
     return strategy, model, state, tx, batch
 
@@ -531,11 +562,29 @@ def check_uniform_branches(colls, where: str) -> List[Finding]:
     return findings
 
 
+def _is_pipeline_method(method: str) -> bool:
+    """Does this method (legacy name OR mesh spec) run the explicit
+    stage schedules — i.e. does the schedule axis apply to it?"""
+    return method in PIPELINE_STRATEGIES or spec_is_pipeline(method)
+
+
+def _contract_requirements(
+    method: str, schedule: Optional[str]
+) -> Tuple[JaxprComm, ...]:
+    """The comms contract for one method: the derived legacy table for
+    strategy names, derived on the fly from the parsed spec for mesh
+    configs — one rule engine either way."""
+    if is_mesh_spec(method):
+        cfg = parse_mesh_spec(method)
+        return _derived_contract(cfg, schedule if cfg.is_pipeline else None)
+    key = (method, schedule if method in PIPELINE_STRATEGIES else None)
+    return JAXPR_CONTRACTS.get(key, ())
+
+
 def check_contract(method: str, schedule: Optional[str], colls,
                    where: str) -> List[Finding]:
-    key = (method, schedule if method in PIPELINE_STRATEGIES else None)
     findings = []
-    for req in JAXPR_CONTRACTS.get(key, ()):
+    for req in _contract_requirements(method, schedule):
         candidates = [
             c for c in colls
             if c.kind == req.kind
@@ -629,7 +678,7 @@ def check_collective_fingerprints(
     gated on ``process_index() == 2`` traces identically on ranks 0 and
     1 — invisible to ``rank-divergent-collective`` — but desyncs a
     3-process launch; here it is caught before any rank spawns."""
-    if method in PIPELINE_STRATEGIES and schedule is None:
+    if _is_pipeline_method(method) and schedule is None:
         schedule = "gpipe"
     fps = [
         collective_fingerprint(method, schedule, r) for r in range(world)
@@ -730,33 +779,41 @@ def hlo_collectives(method: str, schedule: Optional[str] = None) -> set:
 def check_hlo_contract(method: str, schedule: Optional[str]) -> List[Finding]:
     where = _combo_tag(method, schedule, "compiled train")
     ops = hlo_collectives(method, schedule)
-    if method == "TP":
-        if not (ops & TP_HLO_ANY_OF):
-            return [Finding(
-                rule="comms-contract-hlo",
-                where=where,
-                message=(
-                    f"optimized HLO contains none of "
-                    f"{sorted(TP_HLO_ANY_OF)} — TP's sharded channels are "
-                    f"not actually communicating (degenerated to "
-                    f"replication?); found {sorted(ops)}"
-                ),
-                layer="collectives",
-            )]
-        return []
     required = EXPECTED_HLO_COLLECTIVES.get(method)
-    if required is None or required <= ops:
-        return []
-    return [Finding(
-        rule="comms-contract-hlo",
-        where=where,
-        message=(
-            f"optimized HLO is missing {sorted(required - ops)} (found "
-            f"{sorted(ops)}) — the strategy silently degenerated: its "
-            f"parallelism implies that communication"
-        ),
-        layer="collectives",
-    )]
+    any_of_tier = method == "TP"
+    if required is None and is_mesh_spec(method):
+        # mesh specs derive their HLO contract from the parsed rules;
+        # a channel model axis adds the any-of tier ON TOP of the exact
+        # set — a DP x TP hybrid whose data all-reduce regresses away
+        # must fail even while its channel collectives satisfy any-of
+        cfg = parse_mesh_spec(method)
+        required = derive_hlo_contract(cfg)
+        any_of_tier = channel_comms_required(cfg)
+    findings: List[Finding] = []
+    if any_of_tier and not (ops & TP_HLO_ANY_OF):
+        findings.append(Finding(
+            rule="comms-contract-hlo",
+            where=where,
+            message=(
+                f"optimized HLO contains none of "
+                f"{sorted(TP_HLO_ANY_OF)} — TP's sharded channels are "
+                f"not actually communicating (degenerated to "
+                f"replication?); found {sorted(ops)}"
+            ),
+            layer="collectives",
+        ))
+    if required and not required <= ops:
+        findings.append(Finding(
+            rule="comms-contract-hlo",
+            where=where,
+            message=(
+                f"optimized HLO is missing {sorted(required - ops)} (found "
+                f"{sorted(ops)}) — the strategy silently degenerated: its "
+                f"parallelism implies that communication"
+            ),
+            layer="collectives",
+        ))
+    return findings
 
 
 # -- drivers -----------------------------------------------------------------
@@ -765,7 +822,7 @@ def combos_for(strategies: Sequence[str] = ANALYSIS_STRATEGIES,
                ) -> List[Tuple[str, Optional[str]]]:
     combos: List[Tuple[str, Optional[str]]] = []
     for method in strategies:
-        if method in PIPELINE_STRATEGIES:
+        if _is_pipeline_method(method):
             combos.extend((method, s) for s in schedules)
         else:
             combos.append((method, None))
@@ -777,7 +834,7 @@ def analyze_combo(method: str, schedule: Optional[str] = None,
                   ) -> List[Finding]:
     """Run every layer-1 check for one strategy × schedule combo.
     Trace-only unless ``hlo``; zero device execution either way."""
-    if method in PIPELINE_STRATEGIES and schedule is None:
+    if _is_pipeline_method(method) and schedule is None:
         # the trace rig defaults a missing schedule to gpipe; the
         # contract key must name the program actually traced, or the
         # ('MP', None) lookup misses JAXPR_CONTRACTS and the
@@ -785,7 +842,25 @@ def analyze_combo(method: str, schedule: Optional[str] = None,
         schedule = "gpipe"
     findings: List[Finding] = []
 
-    train_jaxpr = trace_train(method, schedule)
+    try:
+        train_jaxpr = trace_train(method, schedule)
+    except ValueError as exc:
+        if is_mesh_spec(method):
+            # a mesh spec that cannot BUILD (model x stage, divisibility,
+            # device count) is a CONFIG refusal, not an analyzer crash:
+            # report it as a finding so the launch preflights (elastic,
+            # bench_multi) refuse the geometry pre-spawn with the reason,
+            # and an `analyze --mesh` run keeps its other combos' results
+            return [Finding(
+                rule="mesh-config",
+                where=_combo_tag(method, schedule, "train"),
+                message=(
+                    f"mesh config cannot build on the analysis rig: "
+                    f"{exc}"
+                ),
+                layer="collectives",
+            )]
+        raise
     train_colls = extract_collectives(train_jaxpr)
     where = _combo_tag(method, schedule, "train")
     findings += check_axis_binding(train_colls, where)

@@ -32,14 +32,17 @@ The model is deliberately simple — three additive terms:
     program comes from the jaxpr — every ppermute/psum with its actual
     per-device payload bytes. GSPMD strategies trace EMPTY jaxpr
     programs (XLA inserts their collectives at compile time), so
-    ``gspmd_comms_program`` supplies the analytic equivalent: DP/DDP's
-    gradient all-reduce, FSDP's per-step parameter all-gathers (in the
-    **storage** dtype — ``--dtype bf16_params`` halves these bytes,
-    which is exactly why dtype is a real search dimension) plus the
-    gradient reduce-scatter. SP/TP's halo/channel exchanges are NOT
-    modeled (returned empty, flagged ``comms_model: none`` by the
-    planner) — their cost is compute/memory-dominated and a wrong
-    guess would be worse than an honest absence.
+    ``mesh_comms_program`` supplies the analytic equivalent, composed
+    per mesh axis from the sharding rules (parallel/mesh.py): the data
+    axis's gradient all-reduce (or FSDP's per-step parameter
+    all-gathers in the **storage** dtype — ``--dtype bf16_params``
+    halves these bytes, which is exactly why dtype is a real search
+    dimension — plus the gradient reduce-scatter), the spatial model
+    axis's per-conv boundary-row halo ppermutes, and the channel model
+    axis's per-conv activation all-gathers. Hybrid mesh points
+    (DP x TP, FSDP x SP) sum their axes' terms, so they rank honestly
+    against pure ones. The legacy ``gspmd_comms_program`` remains as
+    the data-axis-only strategy-name surface.
 
 Absolute times are rough; the model exists to RANK points, and every
 term is monotone in the quantity it abstracts. Numbers live in
@@ -139,9 +142,10 @@ def gspmd_comms_program(strategy: str, param_storage_bytes: int,
     GSPMD-inserted (empty jaxpr program). ``param_storage_bytes`` is in
     the policy's STORAGE dtype — the bf16_params halving rides through
     here into FSDP's all-gather term. ``grad_bytes`` is f32 (the stated
-    REDUCE_DTYPE contract). Strategies not listed (SP/TP halo/channel
-    exchanges) return empty — unmodeled, not free: the planner marks
-    them ``comms_model: none``."""
+    REDUCE_DTYPE contract). Strategies not listed (SP/TP) return
+    empty — the planner now routes every config through
+    :func:`mesh_comms_program`, which models their halo/channel axes
+    too; this strategy-name surface survives for direct callers."""
     n = int(axis_size)
     if n <= 1:
         return []
@@ -156,6 +160,72 @@ def gspmd_comms_program(strategy: str, param_storage_bytes: int,
             ("reduce_scatter", grad_bytes, n),
         ]
     return []
+
+
+#: Conv applications per UNet level entering the halo/channel terms: a
+#: DoubleConv on the down path and one on the up path = 4 convs of that
+#: level's plane scale. Order-of-magnitude accounting, like every
+#: number here.
+CONVS_PER_LEVEL = 4
+
+
+def mesh_comms_program(
+    *,
+    data: int = 1,
+    model: int = 1,
+    model_role: str = "channel",
+    params_rule: str = "replicate",
+    param_storage_bytes: int = 0,
+    grad_bytes: int = 0,
+    level_planes: Iterable[Tuple[int, int]] = (),
+) -> List[CommOp]:
+    """Analytic per-step comms for a mesh config whose collectives are
+    GSPMD-inserted (empty jaxpr program) — the rule-engine
+    generalization of :func:`gspmd_comms_program`, composing per-axis
+    terms so hybrid points (DP x TP, FSDP x SP, ...) rank honestly
+    against pure ones:
+
+    * **data axis** — the gradient all-reduce (params replicated) or
+      the ZeRO-3 dance (``fsdp`` rules: 2 param all-gathers in the
+      STORAGE dtype — bf16_params halves them — plus the f32 gradient
+      reduce-scatter);
+    * **model axis, ``spatial`` role** — the per-conv halo exchanges:
+      one boundary-row ppermute each way per conv application
+      (``level_planes`` rows of ``(plane_bytes, row_bytes)`` per UNet
+      level, CONVS_PER_LEVEL convs each, forward + backward);
+    * **model axis, ``channel`` role** — per-conv channel traffic: the
+      next layer contracts over sharded in-channels, so each conv's
+      input activation plane is (re)gathered over 'model' — one
+      all-gather per conv application, forward + backward. The payload
+      is the FULL gathered plane (the all-gather convention every
+      other term here uses: ``collective_time``'s ring factor applies
+      (n-1)/n to the whole buffer, exactly like the FSDP param
+      all-gathers above).
+
+    These were the planner's ``comms_model: none`` gap: SP/TP (and
+    every model-axis hybrid) previously ranked with a silent zero-comms
+    advantage. The terms are monotone in what they abstract — never a
+    measurement."""
+    program: List[CommOp] = []
+    d, m = int(data), int(model)
+    if d > 1:
+        if "fsdp" in params_rule:
+            program += [
+                ("all_gather", param_storage_bytes, d),
+                ("all_gather", param_storage_bytes, d),
+                ("reduce_scatter", grad_bytes, d),
+            ]
+        else:
+            program.append(("psum", grad_bytes, d))
+    if m > 1:
+        for plane_bytes, row_bytes in level_planes:
+            for _ in range(2 * CONVS_PER_LEVEL):  # forward + backward
+                if model_role == "spatial":
+                    # boundary rows cross one link each way per conv
+                    program.append(("ppermute", 2 * int(row_bytes), m))
+                else:
+                    program.append(("all_gather", int(plane_bytes), m))
+    return program
 
 
 #: HBM round-trips over the (B·H·W) f32 activation/probability plane

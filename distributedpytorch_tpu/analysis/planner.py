@@ -59,6 +59,13 @@ from distributedpytorch_tpu.analysis import cost_model as cm
 # import-light at module level (no jax): safe on bench_multi's jax-free
 # load_plan/rank_legs path
 from distributedpytorch_tpu.analysis.collectives import PIPELINE_STRATEGIES
+# the mesh rule engine (parallel/mesh.py, jax-free): mesh-shape specs
+# (``4x1x2``) enter the search grid exactly like strategy names, and
+# the leg mapping recognizes hybrid geometries
+from distributedpytorch_tpu.parallel.mesh import (
+    spec_is_hybrid,
+    spec_is_pipeline,
+)
 
 #: Plan-file schema version: bench_multi refuses (degrades to its own
 #: ordering) on any other value — a stale plan must never silently
@@ -73,6 +80,11 @@ PLAN_KIND = "dpt_plan"
 #: at the reference geometry on CPU), so ``--budget-s`` matters.
 DEFAULT_GRID: Dict[str, tuple] = {
     "strategies": ("singleGPU", "MP"),
+    # Mesh-shape axis (parallel/mesh.py specs, e.g. 4x1x2 / 2x2x1 /
+    # 1x2x4): OFF by default — the historical grids stay byte-stable —
+    # and widened by --meshes; spec points enumerate exactly like
+    # strategies (stage-axis specs get the schedule x microbatch axes).
+    "meshes": (),
     "schedules": ("gpipe", "1f1b"),
     "microbatches": (2, 8),
     "s2d_levels": (0, 2, 3),
@@ -122,6 +134,10 @@ class PlanPoint:
         return d
 
 
+def _is_pipeline_point(strategy: str) -> bool:
+    return strategy in PIPELINE_STRATEGIES or spec_is_pipeline(strategy)
+
+
 def enumerate_points(
     strategies: Sequence[str],
     schedules: Sequence[str],
@@ -143,11 +159,12 @@ def enumerate_points(
     # xla twins must precede their pallas derivations in the walk
     kerns = sorted({str(k) for k in kernels}, key=lambda k: k != "xla")
     for strategy in strategies:
+        pipelined = _is_pipeline_point(strategy)
         scheds: Sequence[Optional[str]] = (
-            tuple(schedules) if strategy in PIPELINE_STRATEGIES else (None,)
+            tuple(schedules) if pipelined else (None,)
         )
         mbs: Sequence[Optional[int]] = (
-            tuple(microbatches) if strategy in PIPELINE_STRATEGIES else (None,)
+            tuple(microbatches) if pipelined else (None,)
         )
         for sched, m, b, s2d, remat, dt, kern in itertools.product(
             scheds, mbs, batches, s2d_levels, remats, dtypes, kerns
@@ -193,6 +210,24 @@ def _tree_count(tree) -> int:
     return int(sum(math.prod(leaf.shape) for leaf in jax.tree.leaves(tree)))
 
 
+def _activation_levels(image_size, widths, batch: int,
+                       itemsize: int) -> tuple:
+    """Per-UNet-level ``(plane_bytes, row_bytes)`` of the conv
+    activations in the compute dtype — what the analytic halo (spatial)
+    and channel-gather (TP) comms terms scale with
+    (cost_model.mesh_comms_program). ``widths`` None = the flagship
+    architecture's documented channel plan."""
+    width, height = image_size  # (W, H), the reference convention
+    out = []
+    for level, channels in enumerate(widths or (32, 64, 128, 256)):
+        h, w = max(height >> level, 1), max(width >> level, 1)
+        out.append((
+            batch * h * w * int(channels) * itemsize,
+            batch * w * int(channels) * itemsize,
+        ))
+    return tuple(out)
+
+
 def _flops_of(compiled) -> Optional[float]:
     """``cost_analysis()`` flops, guarded: absent/odd-shaped analyses on
     some backends must degrade the cost model, never crash the plan."""
@@ -220,7 +255,6 @@ def evaluate_point(point: PlanPoint, image_size, widths,
     strategy itself rejects — the caller records those as infeasible."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from distributedpytorch_tpu.analysis.collectives import (
         compile_train_step_aot,
@@ -287,12 +321,24 @@ def evaluate_point(point: PlanPoint, image_size, widths,
         last_sig = c.signature
     comms_model = "jaxpr" if program else "none"
     if not program and mesh is not None:
-        devices = int(np.prod(list(mesh.shape.values())))
-        program = cm.gspmd_comms_program(
-            strategy.name,
+        # GSPMD configs trace empty programs: compose the analytic
+        # per-axis terms from the strategy's mesh config — the data
+        # axis's grad psum / ZeRO dance, and the model axis's halo
+        # (spatial) or channel-gather (TP) traffic, previously the
+        # ``comms_model: none`` gap that let SP/TP points rank with a
+        # silent zero-comms advantage
+        mc = strategy.mesh_config
+        program = cm.mesh_comms_program(
+            data=mc.data,
+            model=mc.model,
+            model_role=mc.model_role,
+            params_rule=mc.params,
             param_storage_bytes=_tree_bytes(params),
             grad_bytes=_tree_count(params) * 4,
-            axis_size=devices,
+            level_planes=_activation_levels(
+                cfg.image_size, widths, point.batch,
+                jnp.dtype(policy.compute_dtype).itemsize,
+            ),
         )
         if program:
             comms_model = "analytic"
@@ -424,7 +470,11 @@ def _static_findings(points: Sequence[PlanPoint]) -> Dict[str, List[str]]:
     findings: Dict[str, List[str]] = {}
     combos = sorted(
         {(p.strategy, p.schedule) for p in points
-         if p.strategy in ANALYSIS_STRATEGIES},
+         if p.strategy in ANALYSIS_STRATEGIES
+         # stage-axis mesh specs run the explicit schedules — the
+         # checker derives their contract from the parsed spec; pure
+         # GSPMD specs have nothing jaxpr-level to check (HLO tier)
+         or spec_is_pipeline(p.strategy)},
         key=lambda c: (c[0], c[1] or ""),
     )
     for method, schedule in combos:
@@ -445,6 +495,7 @@ def _static_findings(points: Sequence[PlanPoint]) -> Dict[str, List[str]]:
 
 def plan(
     strategies: Sequence[str] = DEFAULT_GRID["strategies"],
+    meshes: Sequence[str] = DEFAULT_GRID["meshes"],
     schedules: Sequence[str] = DEFAULT_GRID["schedules"],
     microbatches: Sequence[int] = DEFAULT_GRID["microbatches"],
     s2d_levels: Sequence[int] = DEFAULT_GRID["s2d_levels"],
@@ -473,6 +524,14 @@ def plan(
     t_start = time.monotonic()
     mm = MESH_MODELS_LOOKUP(mesh_model)
     hbm_budget_bytes = int(hbm_gb * 2**30)
+    # mesh-shape points are strategies to the rest of the pipeline:
+    # build_strategy resolves specs, the collective checker derives
+    # their contracts, and evaluate_point's mesh_config drives the
+    # analytic comms — appended after the named strategies so legacy
+    # grids keep their exact walk order
+    strategies = tuple(strategies) + tuple(
+        m for m in meshes if m not in strategies
+    )
     kernels = tuple(kernels)
     if any(k != "xla" for k in kernels) and "xla" not in kernels:
         # every pallas point derives from its xla twin — force the pair
@@ -551,6 +610,7 @@ def plan(
         "widths": list(widths) if widths else None,
         "grid": {
             "strategies": list(strategies),
+            "meshes": list(meshes),
             "schedules": list(schedules),
             "microbatches": list(microbatches),
             "s2d_levels": list(s2d_levels),
@@ -628,8 +688,15 @@ def load_plan(path: str) -> Optional[dict]:
 #: move a wedge-suspect compile to the front of a chip window.
 _MODELED_LEVERS = frozenset(
     {"BENCH_S2D_LEVELS", "BENCH_BATCH", "BENCH_ARCH",
-     "BENCH_PIPELINE_SWEEP", "BENCH_PALLAS_LOSS", "BENCH_KERNEL_SWEEP"}
+     "BENCH_PIPELINE_SWEEP", "BENCH_PALLAS_LOSS", "BENCH_KERNEL_SWEEP",
+     "BENCH_MESH_SWEEP"}
 )
+
+#: Selector sentinel: match any ranked HYBRID mesh-spec point (>= 2
+#: non-trivial axes). The mesh_sweep leg's predicted win is its hybrid
+#: cells, so its rank is the best hybrid geometry the plan found — a
+#: plan without ranked hybrid points leaves the leg hand-ordered.
+HYBRID_MESH = "__hybrid_mesh__"
 
 #: Point fields a selector may constrain that old plan files (written
 #: before the axis existed) don't carry: a missing field reads as its
@@ -649,6 +716,11 @@ def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
         # a best-case proxy (where do MP configs land at all), so only
         # the strategy is constrained
         return {"strategy": "MP"}
+    if env.get("BENCH_MESH_SWEEP") == "1":
+        # the mesh sweep A/Bs hybrid vs pure geometries; its rank is
+        # the best ranked hybrid mesh point (pure points already rank
+        # through their own legs)
+        return {"strategy": HYBRID_MESH}
     selector = {
         "strategy": "singleGPU",
         "batch": int(env.get("BENCH_BATCH", "4")),
@@ -673,6 +745,13 @@ def _leg_selector(env: Mapping[str, str]) -> Optional[Dict[str, object]]:
         # prediction never moves a Mosaic-unvetted compile earlier.
         selector["kernels"] = "pallas"
     return selector
+
+
+def _selector_field_matches(point: dict, field: str, want) -> bool:
+    got = point.get(field, _SELECTOR_DEFAULTS.get(field))
+    if want == HYBRID_MESH:
+        return spec_is_hybrid(got or "")
+    return got == want
 
 
 def rank_legs(payload: dict, configs) -> Dict[str, dict]:
@@ -705,7 +784,7 @@ def rank_legs(payload: dict, configs) -> Dict[str, dict]:
         matches = [
             p for p in ranked_points
             if all(
-                p.get(k, _SELECTOR_DEFAULTS.get(k)) == v
+                _selector_field_matches(p, k, v)
                 for k, v in selector.items()
             )
         ]
@@ -735,6 +814,14 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--out", default="plan.json",
                     help="Plan file to write (versioned JSON)")
     ap.add_argument("--strategies", nargs="+", default=list(g["strategies"]))
+    ap.add_argument("--meshes", nargs="+", default=list(g["meshes"]),
+                    metavar="SPEC",
+                    help="Mesh-shape points (DxMxS[@fsdp|sp], parallel/"
+                         "mesh.py) searched ALONGSIDE --strategies — "
+                         "e.g. 4x1x2 2x2x2 1x2x4; stage-axis specs get "
+                         "the schedule x microbatch axes, and hybrid "
+                         "points rank against pure ones on the same "
+                         "memory/comms terms")
     ap.add_argument("--schedules", nargs="+", default=list(g["schedules"]),
                     choices=["gpipe", "1f1b"])
     ap.add_argument("--microbatches", type=int, nargs="+",
@@ -788,6 +875,14 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     except ValueError as exc:
         print(f"plan: {exc}", file=sys.stderr)
         return EXIT_INFRA
+    from distributedpytorch_tpu.parallel.mesh import parse_mesh_spec
+
+    for spec in args.meshes:
+        try:
+            parse_mesh_spec(spec)
+        except ValueError as exc:
+            print(f"plan: {exc}", file=sys.stderr)
+            return EXIT_INFRA
     hbm_gb = args.hbm_gb if args.hbm_gb is not None else mm.hbm_gb
 
     priors = None
@@ -823,6 +918,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     try:
         payload = plan(
             strategies=args.strategies,
+            meshes=args.meshes,
             schedules=args.schedules,
             microbatches=args.microbatches,
             s2d_levels=args.s2d_levels,

@@ -31,7 +31,12 @@ def get_args():
     # reference flags (train.py:15-26)
     parser.add_argument("--train-method", "-t", type=str, default="singleGPU",
                         help="Training method: singleGPU | DP | DDP | MP | DDP_MP "
-                             "| SP | DDP_SP | TP | FSDP")
+                             "| SP | DDP_SP | TP | FSDP, or a mesh spec "
+                             "DxMxS[@fsdp|sp] over the ('data','model',"
+                             "'stage') mesh — e.g. 4x1x2 (data x pipe), "
+                             "2x2x1 (data x tensor), 2x2x1@fsdp, 1x4x1@sp "
+                             "(docs/DISTRIBUTED.md 'The mesh engine'; the "
+                             "named methods are aliases into mesh configs)")
     parser.add_argument("--validation", "-v", dest="val", type=float, default=10.0,
                         help="Percentage of data used as validation")
     parser.add_argument("--load", "-l", type=str, default=False,

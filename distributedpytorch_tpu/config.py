@@ -14,11 +14,16 @@ from typing import Optional, Tuple
 @dataclasses.dataclass
 class TrainConfig:
     # -- strategy -----------------------------------------------------------
-    # one of: "singleGPU" (kept for CLI parity; means single-device),
-    # "DP", "DDP", "MP", "DDP_MP" (hybrid, new capability),
-    # "SP" / "DDP_SP" (spatial sharding of the image plane, new),
-    # "TP" (out-channel tensor parallelism, new),
-    # "FSDP" (ZeRO-style fully sharded data parallel, new)
+    # A legacy strategy name — "singleGPU" (kept for CLI parity;
+    # single-device), "DP", "DDP", "MP", "DDP_MP", "SP" / "DDP_SP",
+    # "TP", "FSDP" — or a mesh spec "DxMxS[@fsdp|sp]" naming an
+    # arbitrary point on the N-D ('data','model','stage') mesh
+    # (parallel/mesh.py; docs/DISTRIBUTED.md "The mesh engine"):
+    # e.g. "4x1x2" (data x pipeline), "2x2x1" (data x tensor),
+    # "2x2x1@fsdp" (FSDP x tensor), "1x4x1@sp" (spatial). The legacy
+    # names are aliases into the same mesh-rule engine — each resolves
+    # to its mesh config at strategy construction and reproduces
+    # bit-identically as the equivalent spec.
     train_method: str = "singleGPU"
 
     # -- optimization (reference train.py:18-24 defaults) -------------------

@@ -633,7 +633,12 @@ class ElasticSupervisor:
             # bench_multi's serve config is in the no-combos class):
             # nothing to verify statically, nothing to pay for
             return []
-        if self.method_tag not in ANALYSIS_STRATEGIES:
+        from distributedpytorch_tpu.parallel.mesh import is_mesh_spec
+
+        if (
+            self.method_tag not in ANALYSIS_STRATEGIES
+            and not is_mesh_spec(self.method_tag)
+        ):
             return []
         schedule = _worker_arg(
             self.worker_args, ("--pipeline-schedule",), "gpipe",

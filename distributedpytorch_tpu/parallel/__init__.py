@@ -1,25 +1,56 @@
-"""Parallelism strategies over a `jax.sharding.Mesh`.
+"""Parallelism over a `jax.sharding.Mesh`: the composable mesh engine.
 
-Capability parity with the reference's four execution modes plus the hybrid
-(SURVEY.md §2 checklist): single device, DP (single-process data parallel,
-reference train_utils.py:98), DDP (multi-process data parallel with gradient
-all-reduce, train_utils.py:170-248), MP (2-stage microbatched pipeline,
-unet_model.py:14-53), and DDP×MP on a 2-D ('data', 'stage') mesh — expressed
-as mesh + shardings + collectives, not NCCL/CUDA streams.
+Capability parity with the reference's four execution modes plus every
+hybrid (SURVEY.md §2 checklist), all expressed as points in one N-D
+``('data', 'model', 'stage')`` mesh space with per-tree sharding rules
+(``parallel/mesh.py``): single device, DP/DDP (data axis), MP (stage
+axis, reference unet_model.py:14-53), SP/TP (the model axis's spatial /
+channel roles), FSDP (the ``fsdp`` params rule), the named hybrids
+(DDP_MP, DDP_SP), and arbitrary ``-t DxMxS[@rule]`` mesh specs — mesh +
+shardings + collectives, not NCCL/CUDA streams.
+
+Lazily re-exported (PEP 562): ``parallel.mesh`` is the jax-free rules
+module — the dptlint contract derivation, the planner's plan-file path,
+and the elastic supervisor import it, and a plain ``from
+distributedpytorch_tpu.parallel.mesh import ...`` must not drag the
+strategy layer's jax import in through this package ``__init__``.
 """
 
-from distributedpytorch_tpu.parallel.strategy import (  # noqa: F401
-    STRATEGIES,
-    DataParallel,
-    DistributedDataParallel,
-    HybridDataPipeline,
-    Pipeline,
-    SingleDevice,
-    Strategy,
-    build_strategy,
-)
-from distributedpytorch_tpu.parallel.pipeline import (  # noqa: F401
-    PIPELINE_SCHEDULES,
-    make_pipeline_loss_fn,
-    make_pipeline_value_and_grad_fn,
-)
+import importlib
+
+_EXPORTS = {
+    "STRATEGIES": ".strategy",
+    "DataParallel": ".strategy",
+    "DistributedDataParallel": ".strategy",
+    "FullyShardedDataParallel": ".strategy",
+    "GenericMesh": ".strategy",
+    "HybridDataPipeline": ".strategy",
+    "HybridDataSpatial": ".strategy",
+    "Pipeline": ".strategy",
+    "SingleDevice": ".strategy",
+    "SpatialParallel": ".strategy",
+    "Strategy": ".strategy",
+    "TensorParallel": ".strategy",
+    "build_strategy": ".strategy",
+    "PIPELINE_SCHEDULES": ".pipeline",
+    "make_pipeline_forward_fn": ".pipeline",
+    "make_pipeline_loss_fn": ".pipeline",
+    "make_pipeline_value_and_grad_fn": ".pipeline",
+    "MeshConfig": ".mesh",
+    "canonical_spec": ".mesh",
+    "is_mesh_spec": ".mesh",
+    "parse_mesh_spec": ".mesh",
+    "spec_is_pipeline": ".mesh",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}"
+        )
+    module = importlib.import_module(module_name, __name__)
+    return getattr(module, name)

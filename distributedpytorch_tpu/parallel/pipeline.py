@@ -113,6 +113,18 @@ from distributedpytorch_tpu.ops.precision import (
 PIPELINE_SCHEDULES = ("gpipe", "1f1b")
 
 
+def _resolve_data_axis(mesh: Mesh, data_axis):
+    """The unified data-axis plumbing: ``"auto"`` (the builders'
+    default) derives the hybrid data axis from the mesh itself — a
+    'data' axis present means batches shard over it and the stats/grad
+    psums close over ('stage', 'data'). Callers no longer thread the
+    axis by hand (the strategy layer's mesh config IS the mesh); an
+    explicit name or None still overrides for direct API users."""
+    if data_axis == "auto":
+        return "data" if "data" in mesh.axis_names else None
+    return data_axis
+
+
 def default_cuts(num_segments: int, num_stages: int) -> Tuple[int, ...]:
     """Stage boundaries (the segment index each stage s ≥ 1 starts at).
 
@@ -380,7 +392,7 @@ def make_pipeline_loss_fn(
     mesh: Mesh,
     num_microbatches: int = 2,
     stage_axis: str = "stage",
-    data_axis: str = None,
+    data_axis: str = "auto",
     remat: bool = False,
     cuts: Optional[Sequence[int]] = None,
     use_pallas: bool = False,
@@ -401,6 +413,7 @@ def make_pipeline_loss_fn(
     here because inside the shard_map schedule every array is
     device-local, exactly where pallas_call belongs.
     """
+    data_axis = _resolve_data_axis(mesh, data_axis)
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
     stage_fns = _build_stage_fns(model, stage_ranges, remat)
@@ -473,7 +486,7 @@ def make_pipeline_value_and_grad_fn(
     mesh: Mesh,
     num_microbatches: int = 2,
     stage_axis: str = "stage",
-    data_axis: str = None,
+    data_axis: str = "auto",
     remat: bool = False,
     cuts: Optional[Sequence[int]] = None,
     use_pallas: bool = False,
@@ -504,6 +517,7 @@ def make_pipeline_value_and_grad_fn(
             f"pipeline schedule must be one of {PIPELINE_SCHEDULES}, "
             f"got {schedule!r}"
         )
+    data_axis = _resolve_data_axis(mesh, data_axis)
     stateful = _is_stateful(model)
 
     if schedule == "gpipe":
@@ -714,7 +728,7 @@ def make_pipeline_forward_fn(
     mesh: Mesh,
     num_microbatches: int = 2,
     stage_axis: str = "stage",
-    data_axis: str = None,
+    data_axis: str = "auto",
     cuts: Optional[Sequence[int]] = None,
 ) -> Callable:
     """Pipelined inference: ``forward(variables, images) -> preds``.
@@ -726,6 +740,7 @@ def make_pipeline_forward_fn(
     stage axis so the output is replicated over 'stage' (the reference's
     ``.to('cuda:0')`` gather, unet_model.py:53).
     """
+    data_axis = _resolve_data_axis(mesh, data_axis)
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
     stateful = _is_stateful(model)

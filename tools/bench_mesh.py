@@ -1,0 +1,292 @@
+#!/usr/bin/env python3
+"""Mesh-geometry A/B: hybrid vs pure mesh shapes at a FIXED global batch.
+
+The measurement side of the composable-mesh engine (parallel/mesh.py,
+docs/DISTRIBUTED.md "The mesh engine"). Per mesh spec, one cell builds
+the REAL strategy (``build_strategy`` on the spec — the exact step the
+trainer jits), places state+batch under its sharding rules, compiles,
+and records:
+
+* ``step_ms`` / ``imgs_per_sec`` at the fixed global batch — the honest
+  geometry A/B: every cell moves the same number of images per step, so
+  a hybrid's win/loss is layout, not workload;
+* XLA ``memory_analysis`` bytes (``temp_bytes`` / ``argument_bytes`` —
+  per-DEVICE under SPMD partitioning: the number the planner's
+  liveness gate reads);
+* the resolved mesh shape and canonical spec (a spec the device pool
+  cannot satisfy records an explicit ``skipped`` row, never a crash —
+  a single-chip window runs the 1x1x1 cell and skips clean).
+
+Plan-aware: when a plan file is given (``plan_path`` /
+``$DPT_BENCH_PLAN``, written by ``python -m distributedpytorch_tpu plan
+--meshes ...``), cells run planner-ranked-first and each row stamps its
+``plan_rank`` — predicted winners measure before the budget runs out,
+the same contract as bench_multi ``--plan``.
+
+Callable in-process (``mesh_sweep(budget_s=...)``) — registered as the
+``mesh_sweep`` bench_multi config (budget-aware; its pipeline-bearing
+specs ride the static preflight).
+
+Usage: python tools/bench_mesh.py [--batch 8] [--hw 640 960]
+       [--widths 32 64 128 256] [--specs 8x1x1 4x1x2 ...] [--steps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+#: Every stage-bearing spec ``default_specs`` can emit for ANY pool —
+#: what bench_multi's static preflight must clear before the sweep
+#: spends chip budget (a mis-ruled schedule DEADLOCKS the rendezvous
+#: rather than failing). default_specs CAPS its stage cells' data
+#: degree so this list stays closed under pool growth (the schedule
+#: program's structure is set by the stage count, not the data degree
+#: — a capped data axis loses nothing the sweep's hybrid-vs-pure A/B
+#: needs); tests/test_mesh.py pins the closure over a wide pool range.
+PREFLIGHT_STAGE_SPECS = ("1x1x2", "2x1x2", "3x1x2", "4x1x2", "2x1x4")
+
+
+def default_specs(n_devices: int):
+    """Pure vs hybrid geometries over the window's device pool: the
+    pure points (data / stage / model / fsdp) and the hybrids the
+    class-per-strategy design could not express. Specs the pool cannot
+    satisfy are still listed — they record explicit skip rows, so a
+    1-chip window's artifact says WHY the hybrids have no numbers.
+    Stage-bearing cells cap their data degree at the PREFLIGHT_STAGE_
+    SPECS allowlist so every schedule graph the sweep can compile was
+    vetted by the static preflight, on pools of any size."""
+    n = max(int(n_devices), 1)
+    specs = ["1x1x1"]
+    if n >= 2:
+        specs += [f"{n}x1x1", f"{n}x1x1@fsdp", "1x1x2", f"1x{n}x1"]
+    if n >= 4:
+        specs += [f"{min(n // 2, 4)}x1x2", f"{n // 2}x2x1",
+                  f"{n // 2}x2x1@fsdp"]
+    if n >= 8:
+        specs += [f"{min(n // 4, 2)}x1x4"]
+    return specs
+
+
+def _plan_ranks(plan_path, specs) -> dict:
+    """{spec: best plan rank} from a planner file's mesh points (the
+    ``--meshes`` axis); {} when no plan / missing / stale — cells then
+    keep their hand order."""
+    if not plan_path:
+        return {}
+    from distributedpytorch_tpu.analysis.planner import load_plan
+
+    payload = load_plan(plan_path)
+    if payload is None:
+        return {}
+    ranks: dict = {}
+    for p in payload.get("points", ()):
+        if not isinstance(p, dict) or not p.get("feasible"):
+            continue
+        rank = p.get("rank")
+        if not isinstance(rank, int) or isinstance(rank, bool):
+            continue
+        name = p.get("strategy")
+        if name in specs and rank < ranks.get(name, 1 << 30):
+            ranks[name] = rank
+    return ranks
+
+
+def mesh_sweep(
+    batch: int = 8,
+    hw=(64, 96),
+    widths=(8, 16),
+    steps: int = 3,
+    specs=None,
+    budget_s: float = 0.0,
+    plan_path=None,
+    emit=None,
+) -> dict:
+    """The geometry grid at a fixed global batch. Returns a summary
+    dict (also the bench_multi row) and emits one dict per cell.
+    ``budget_s`` > 0 stops opening new cells near the wall budget —
+    already-measured cells keep their rows (the chip-window contract)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributedpytorch_tpu.config import TrainConfig
+    from distributedpytorch_tpu.models.unet import UNet
+    from distributedpytorch_tpu.parallel import build_strategy
+    from distributedpytorch_tpu.train.steps import create_train_state
+
+    t_start = time.monotonic()
+    h, w = hw
+    n_devices = len(jax.devices())
+    specs = list(specs) if specs is not None else default_specs(n_devices)
+    plan_path = plan_path or os.environ.get("DPT_BENCH_PLAN")
+    ranks = _plan_ranks(plan_path, set(specs))
+    if ranks:
+        # planner-ranked cells first, best predicted rank first; the
+        # unranked rest keep their hand order behind them
+        specs = sorted(
+            specs, key=lambda s: (s not in ranks, ranks.get(s, 0))
+        )
+
+    rng = np.random.default_rng(0)
+    batch_np = {
+        "image": rng.random((batch, h, w, 3), dtype=np.float32),
+        "mask": (rng.random((batch, h, w)) > 0.5).astype(np.int32),
+    }
+    rows, cells = [], []
+    for spec in specs:
+        row = {"kind": "mesh_cell", "spec": spec, "batch": batch,
+               "hw": list(hw)}
+        if spec in ranks:
+            row["plan_rank"] = ranks[spec]
+        if budget_s and time.monotonic() - t_start > 0.7 * budget_s:
+            # explicit marker, emitted like every other row — the JSONL
+            # artifact must say "not measured this run", not go silent
+            row["skipped"] = "budget"
+            rows.append(row)
+            if emit is not None:
+                emit(row)
+            continue
+        cfg = TrainConfig(
+            train_method=spec, batch_size=batch, image_size=(w, h),
+            model_widths=tuple(widths),
+        )
+        try:
+            strategy = build_strategy(cfg)
+            policy = strategy.policy
+            model = UNet(dtype=policy.compute_dtype, widths=tuple(widths))
+            params = model.init(
+                jax.random.key(0), jnp.zeros((1, h, w, 3))
+            )["params"]
+            state, tx = create_train_state(
+                params, cfg.learning_rate, cfg.weight_decay, policy=policy
+            )
+            state = strategy.place_state(state)
+            placed = strategy.place_batch(batch_np)
+            step = strategy.build_train_step(model, tx)
+            t0 = time.monotonic()
+            compiled = step.lower(state, placed).compile()
+        except ValueError as exc:
+            # geometry infeasible for THIS pool/model (device count,
+            # batch divisibility, model x stage, more stages than the
+            # model has segments) — an explicit row, not a crash
+            row["skipped"] = f"{type(exc).__name__}: {exc}"
+            rows.append(row)
+            if emit is not None:
+                emit(row)
+            continue
+        ma = compiled.memory_analysis()
+        row.update({
+            "mesh": {} if strategy.mesh is None else {
+                str(k): int(v) for k, v in strategy.mesh.shape.items()
+            },
+            "compile_s": round(time.monotonic() - t0, 2),
+            "argument_bytes": int(ma.argument_size_in_bytes) if ma else None,
+            "temp_bytes": int(ma.temp_size_in_bytes) if ma else None,
+        })
+        try:
+            # time through the JITTED step — the trainer's own dispatch
+            # path. The AOT `compiled` object above (kept for its
+            # memory_analysis) is strict about input shardings, and on
+            # sharded-state geometries GSPMD may pick OUTPUT shardings
+            # that differ from the inputs', so feeding a step's output
+            # state back into the compiled object raises; jax.jit
+            # reshards/recompiles transparently exactly like training.
+            # Two warmups let the output sharding reach its fixed point
+            # before the timed loop.
+            state2, _loss = step(state, placed)
+            state2, _loss = step(state2, placed)
+            jax.block_until_ready(state2)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = step(state2, placed)
+                state2 = out[0]
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / steps
+            row["step_ms"] = round(dt * 1e3, 1)
+            row["imgs_per_sec"] = round(batch / dt, 1)
+        except Exception as exc:  # noqa: BLE001 — recorded, cell survives
+            row["exec_error"] = f"{type(exc).__name__}: {exc}"
+        rows.append(row)
+        cells.append(row)
+        if emit is not None:
+            emit(row)
+
+    from distributedpytorch_tpu.parallel.mesh import spec_is_hybrid
+
+    summary = {"kind": "mesh_sweep", "batch": batch, "hw": list(hw),
+               "widths": list(widths), "devices": n_devices,
+               "plan": plan_path if ranks else None, "rows": rows}
+    timed = [r for r in cells if r.get("imgs_per_sec")]
+    pures = [r for r in timed if not spec_is_hybrid(r["spec"])]
+    hybrids = [r for r in timed if spec_is_hybrid(r["spec"])]
+    if pures:
+        best = max(pures, key=lambda r: r["imgs_per_sec"])
+        summary["best_pure"] = {k: best[k] for k in ("spec", "imgs_per_sec")}
+    if hybrids:
+        best = max(hybrids, key=lambda r: r["imgs_per_sec"])
+        summary["best_hybrid"] = {k: best[k] for k in ("spec", "imgs_per_sec")}
+    if pures and hybrids:
+        summary["hybrid_vs_pure"] = round(
+            summary["best_hybrid"]["imgs_per_sec"]
+            / summary["best_pure"]["imgs_per_sec"], 3)
+    return summary
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8,
+                    help="GLOBAL batch, fixed across every geometry")
+    ap.add_argument("--hw", type=int, nargs=2, default=(640, 960),
+                    help="(H, W) — default the reference geometry")
+    ap.add_argument("--widths", type=int, nargs="+",
+                    default=(32, 64, 128, 256))
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--specs", nargs="+", default=None,
+                    help="Mesh specs to measure (default: pure + hybrid "
+                         "geometries over the visible devices)")
+    ap.add_argument("--plan", default=None,
+                    help="Planner file (plan --meshes ...): ranked cells "
+                         "run predicted-winner-first")
+    ap.add_argument("--json", default=None,
+                    help="also append JSON lines to this file")
+    args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    records = []
+
+    def emit(rec):
+        records.append(rec)
+        line = json.dumps(rec)
+        print(line)
+        if args.json:
+            with open(args.json, "a") as f:
+                f.write(line + "\n")
+
+    summary = mesh_sweep(
+        batch=args.batch, hw=tuple(args.hw), widths=tuple(args.widths),
+        steps=args.steps, specs=args.specs, plan_path=args.plan, emit=emit,
+    )
+    emit({k: v for k, v in summary.items() if k != "rows"})
+
+    print("\n| spec | step ms | imgs/s | temp bytes | arg bytes | plan rank |")
+    print("|---|---|---|---|---|---|")
+    for r in records:
+        if r.get("kind") != "mesh_cell" or "step_ms" not in r:
+            continue
+        print(f"| {r['spec']} | {r['step_ms']} | {r['imgs_per_sec']} "
+              f"| {r.get('temp_bytes')} | {r.get('argument_bytes')} "
+              f"| {r.get('plan_rank', '-')} |")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

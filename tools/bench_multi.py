@@ -102,6 +102,18 @@ CONFIGS = [
     # to check (no-combos fast path, like serve_bench). Budget covers 3
     # train-step compiles + 2 forward compiles + bounded timed steps.
     ("dtype_sweep", {"BENCH_DTYPE_SWEEP": "1"}, 900.0),
+    # Mesh-geometry A/B (tools/bench_mesh.py): hybrid vs pure mesh
+    # shapes (parallel/mesh.py specs — DP / FSDP / MP / TP pure points
+    # vs DxMxS hybrids) at a FIXED global batch — imgs/s + per-device
+    # memory_analysis bytes per geometry, the measurement row behind
+    # the composable-mesh engine and the planner's --meshes axis.
+    # Plan-aware: with --plan, cells run planner-ranked-first and rows
+    # stamp plan_rank. Compile class: the same GSPMD + shard_map
+    # pipeline graphs the strategy tests compile in tier-1; specs the
+    # window's device pool cannot satisfy skip clean (a 1-chip window
+    # measures 1x1x1 and records explicit skips). Pipeline-bearing
+    # specs ride the static preflight (the analyze --mesh surface).
+    ("mesh_sweep", {"BENCH_MESH_SWEEP": "1"}, 600.0),
     # Per-kernel compile-only Mosaic probes (ops/kernels.PROBES via
     # tools/probe_kernels.py — the wgrad_pallas_probe pattern, one row
     # per kernel): 60 s to learn accepted-or-rejected for EVERY Pallas
@@ -360,6 +372,17 @@ def _preflight_combos(env: dict):
     skip, not block (tests/test_bench_multi.py pins this)."""
     if env.get("BENCH_PIPELINE_SWEEP") == "1":
         return (("MP", ("gpipe", "1f1b")),)
+    if env.get("BENCH_MESH_SWEEP") == "1":
+        # every stage-bearing cell the sweep can run (bench_mesh.
+        # PREFLIGHT_STAGE_SPECS covers default_specs for any pool up to
+        # 8 devices — the 4-stage 2x1x4 program is structurally
+        # different from the 2-stage ones and must be vetted too); the
+        # analyzer accepts mesh specs directly (contracts derive from
+        # the sharding rules — the analyze --mesh surface). The sweep
+        # runs the config default schedule (gpipe).
+        from tools.bench_mesh import PREFLIGHT_STAGE_SPECS
+
+        return tuple((spec, ("gpipe",)) for spec in PREFLIGHT_STAGE_SPECS)
     return ()
 
 
@@ -484,6 +507,19 @@ def _run_one(bench, name: str, env: dict, budget: float) -> dict:
                 budget_s=budget,
                 priors=load_priors(priors_path),
             )
+        if env.get("BENCH_MESH_SWEEP") == "1":
+            # mesh-geometry grid (tools/bench_mesh.py) at the reference
+            # geometry — in-process, budget-aware; planner-ranked cells
+            # first when the session carries a plan ($DPT_BENCH_PLAN)
+            from tools.bench_mesh import mesh_sweep
+
+            return mesh_sweep(
+                batch=int(env.get("BENCH_BATCH", 8)),
+                hw=(int(env.get("BENCH_H", 640)), int(env.get("BENCH_W", 960))),
+                widths=(32, 64, 128, 256),
+                steps=5,
+                budget_s=budget,
+            )
         if env.get("BENCH_DTYPE_SWEEP") == "1":
             # precision-policy grid (tools/bench_dtype.py) at the
             # reference geometry — in-process, budget-aware
@@ -532,6 +568,11 @@ def main(argv=None) -> int:
     os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
 
     plan_ranks = load_plan_ranks(args.plan)
+    if args.plan:
+        # the in-process sweeps that are themselves plan-aware (the
+        # mesh sweep's ranked-cells-first ordering) read the session's
+        # plan from here
+        os.environ["DPT_BENCH_PLAN"] = args.plan
     state = load_state(args.out)
     todo = order_by_plan(
         [(n, e, b) for n, e, b in CONFIGS

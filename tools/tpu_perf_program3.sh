@@ -49,9 +49,13 @@ if [ ! -f "$PLAN" ]; then
     echo "== generating auto-planner plan (CPU-only)"
     PLAN_KERNELS=""
     [ -f "$PRIORS" ] && PLAN_KERNELS="--kernel-priors $PRIORS"
+    # --meshes: the composable-mesh axis (docs/DISTRIBUTED.md "The mesh
+    # engine") — hybrid geometries rank against the pure strategies and
+    # the mesh_sweep leg runs planner-ranked cells first
     timeout --signal=TERM 1800 \
         python -m distributedpytorch_tpu plan --out "$PLAN" \
-        --strategies singleGPU MP --remat off --dtypes bf16 \
+        --strategies singleGPU MP --meshes 4x1x2 2x2x1 2x2x1@fsdp \
+        --remat off --dtypes bf16 \
         --budget-s 1200 $PLAN_KERNELS \
         || echo "plan generation failed — bench_multi will use its default order"
 fi
