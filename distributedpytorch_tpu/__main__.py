@@ -33,7 +33,14 @@ the serving tier:
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008
 
 AOT-compiled, continuous-batching inference over HTTP (serve/,
-docs/SERVING.md) — the inference-side production workload."""
+docs/SERVING.md) — the inference-side production workload — and its
+executable store manager:
+
+    python -m distributedpytorch_tpu aot {warm,ls,gc}
+
+prewarm / inspect / LRU-bound the content-addressed AOT executable
+store (utils/aotstore.py, docs/PERFORMANCE.md "AOT executable
+store")."""
 
 import sys
 
@@ -61,6 +68,10 @@ def main() -> None:
         from distributedpytorch_tpu.serve.cli import main as serve_main
 
         sys.exit(serve_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == "aot":
+        from distributedpytorch_tpu.utils.aotstore import main as aot_main
+
+        sys.exit(aot_main(sys.argv[2:]))
     from distributedpytorch_tpu.cli import main as cli_main
 
     cli_main()

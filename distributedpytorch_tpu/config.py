@@ -357,6 +357,13 @@ class ServeConfig:
     # priors exactly like training.
     kernels: str = "xla"
     kernel_priors: Optional[str] = None
+    # Content-addressed AOT executable store (--aot-cache,
+    # utils/aotstore.py, docs/PERFORMANCE.md "AOT executable store"):
+    # startup LOADS each bucket executable from this directory instead
+    # of compiling on hit, compiles-and-persists on miss; corrupt or
+    # version-skewed entries are refused loudly and recompiled. None =
+    # resolve from $DPT_AOT_CACHE (unset = off); "" = force off.
+    aot_cache: Optional[str] = None
 
     # -- batching -----------------------------------------------------------
     # The padded bucket ladder: every dispatch rides one of exactly these

@@ -404,6 +404,17 @@ class ElasticSupervisor:
             "DPT_FLIGHT_DIR",
             os.path.join(self.run_dir, f"attempt{attempt}"),
         )
+        # shared AOT executable store (utils/aotstore.py) for serve
+        # fleets: ONE dir across ranks AND attempts — a relaunch loads
+        # the executables attempt 0 compiled instead of re-paying the
+        # whole ladder. Safe shared (unlike the per-rank XLA cache
+        # below): entries are integrity-footed and atomically renamed,
+        # and racing ranks write identical bytes under identical keys.
+        # An operator's own $DPT_AOT_CACHE (or base_env) wins.
+        if self.workload == "serve":
+            env.setdefault(
+                "DPT_AOT_CACHE", os.path.join(self.run_dir, "aot_cache")
+            )
         # per-rank persistent XLA compilation caches: co-launched ranks
         # compiling identical tiny-model entries race a shared cache dir
         # (same reason tests/test_multiprocess.py splits per rank)

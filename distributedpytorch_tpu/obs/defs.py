@@ -110,6 +110,13 @@ SERVE_REPLICA_HINT = REGISTRY.gauge(
     "dpt_serve_replica_hint",
     "Recommended replica count from queue-depth/shed hysteresis "
     "(recommendation only — serve/autoscale.py)")
+AOT_CACHE = REGISTRY.counter(
+    "dpt_aot_cache_total",
+    "AOT executable store events (utils/aotstore.py): hit = loaded a "
+    "serialized executable (zero compiles), miss = no entry "
+    "(compile-and-persist), skew = entry present but corrupt or "
+    "runtime/identity-skewed (refused loudly, recompiled), evicted = "
+    "removed by `aot gc`", ("result",))
 
 # -- request tracing (obs/reqtrace.py; recorded from completion workers
 #    and ingress rejection paths — never the dispatch loop) -----------------

@@ -95,6 +95,15 @@ def get_args(argv=None):
                         help="Per-chip Mosaic probe priors file "
                              "(tools/probe_kernels.py): kernels the "
                              "chip's compiler rejected disengage loudly")
+    parser.add_argument("--aot-cache", type=str, default=None,
+                        help="Content-addressed AOT executable store "
+                             "directory (utils/aotstore.py; default "
+                             "$DPT_AOT_CACHE, unset = off): startup "
+                             "loads serialized bucket executables "
+                             "instead of compiling on hit, compiles-"
+                             "and-persists on miss; corrupt/skewed "
+                             "entries are refused loudly and "
+                             "recompiled (docs/PERFORMANCE.md)")
     parser.add_argument("--buckets", type=int, nargs="+", default=(1, 2, 4, 8),
                         help="Padded batch bucket ladder — one AOT compile "
                              "per bucket per replica at startup")
@@ -216,6 +225,7 @@ def to_config(args):
         threshold=args.threshold,
         kernels=args.kernels,
         kernel_priors=args.kernel_priors,
+        aot_cache=args.aot_cache,
         bucket_sizes=tuple(args.buckets),
         slo_ms=args.slo_ms,
         eager_when_idle=not args.no_eager,

@@ -80,10 +80,13 @@ class ServeEngine:
         channels: int = 3,
         quantized: bool = False,
         kernels="xla",
+        aot_cache=None,
+        engine_fingerprint: Optional[str] = None,
     ):
         import jax
 
         from distributedpytorch_tpu.ops.kernels import get_kernel_policy
+        from distributedpytorch_tpu.utils.aotstore import AOTStore
 
         self.planner = BucketPlanner(bucket_sizes)
         self.model = model
@@ -121,6 +124,24 @@ class ServeEngine:
         )
         variables = bundle_variables(model, params, model_state)
 
+        # content-addressed AOT executable store (utils/aotstore.py):
+        # on hit each bucket executable LOADS instead of compiling; a
+        # raw-built engine without a model fingerprint disables the
+        # store — a key missing the model identity could load a
+        # wrong program (engine_from_checkpoint always computes one)
+        self.fingerprint = engine_fingerprint
+        self.aot_store = AOTStore.resolve(aot_cache)
+        if self.aot_store is not None and not self.fingerprint:
+            logger.warning(
+                "AOT executable store at %s DISABLED for this engine: "
+                "no engine fingerprint (pass engine_fingerprint=... for "
+                "raw-built engines)", self.aot_store.root,
+            )
+            self.aot_store = None
+        # lifetime _compile_bucket invocations — the compile-count spy
+        # seam (tests) and the rollout path's zero-recompile accounting
+        self.aot_compiles = 0
+
         devices = jax.devices()
         n = max(1, min(int(replicas), len(devices)))
         if replicas > len(devices):
@@ -132,10 +153,12 @@ class ServeEngine:
         self.replicas: List[Replica] = [
             self._build_replica(i, devices[i], variables) for i in range(n)
         ]
+        loaded = self.aot_store.stats["hit"] if self.aot_store else 0
         logger.info(
-            "AOT-compiled %d bucket executables (%s) x %d replica(s) in "
-            "%.1f s — first-request latency pays no JIT",
-            len(self.planner.sizes), list(self.planner.sizes), n,
+            "AOT-compiled %d + store-loaded %d bucket executables (%s) "
+            "x %d replica(s) in %.1f s — first-request latency pays "
+            "no JIT",
+            self.aot_compiles, loaded, list(self.planner.sizes), n,
             time.monotonic() - t0,
         )
 
@@ -161,11 +184,60 @@ class ServeEngine:
             x_sds = jax.ShapeDtypeStruct(
                 (b, h, w, self.channels), jnp.float32, sharding=sharding
             )
-            compiled[b] = jitted.lower(vars_dev, x_sds).compile()
+            key = meta = exe = None
+            if self.aot_store is not None:
+                key, meta = self._entry_key(b, device)
+                exe = self.aot_store.load(key, meta)
+            if exe is None:
+                exe = self._compile_bucket(jitted, vars_dev, x_sds)
+                if self.aot_store is not None:
+                    self.aot_store.save(key, meta, exe)
+            compiled[b] = exe
         return Replica(
             index=index, device=device, sharding=sharding,
             variables=vars_dev, compiled=compiled,
         )
+
+    def _compile_bucket(self, jitted, vars_dev, x_sds):
+        """The engine's ONLY compile site — store hits never reach it,
+        which is what the compile-count spy tests pin."""
+        self.aot_compiles += 1
+        return jitted.lower(vars_dev, x_sds).compile()
+
+    def _entry_key(self, bucket: int, device) -> Tuple[str, dict]:
+        """Store key for one bucket executable on one device. The
+        on-device mask threshold is key material (it is baked into the
+        trace); the device is too — each executable carries a
+        ``SingleDeviceSharding`` and deserializes pinned to it."""
+        from distributedpytorch_tpu.utils.aotstore import entry_key
+
+        h, w = self.input_hw
+        return entry_key(
+            self.fingerprint,
+            bucket,
+            (bucket, h, w, self.channels),
+            "float32",
+            kernels=self.kernel_policy.name,
+            mask_threshold=(
+                self.threshold if self.mask_on_device else None
+            ),
+            quantized=self.quantized,
+            stateful=self.stateful,
+            device=str(device),
+        )
+
+    @property
+    def aot_cache_stats(self) -> dict:
+        """The store's cold-start story for THIS engine build (the
+        serve ``/stats`` ``aot_cache`` block; the process-wide view is
+        the ``dpt_aot_cache_total`` counter family)."""
+        base = {"enabled": False, "dir": None,
+                "hit": 0, "miss": 0, "skew": 0}
+        if self.aot_store is not None:
+            base.update({"enabled": True, "dir": self.aot_store.root,
+                         **self.aot_store.stats})
+        base["compiles"] = self.aot_compiles
+        return base
 
     @property
     def num_replicas(self) -> int:
@@ -342,8 +414,21 @@ def engine_from_checkpoint(
     """Checkpoint name/path → a ready (AOT-compiled) engine.
     ``quantize="int8"`` serves weights-only int8 (see
     serve/infer.load_inference_bundle for the file-vs-on-load rules)."""
+    from distributedpytorch_tpu.obs.reqtrace import engine_fingerprint
     from distributedpytorch_tpu.serve.infer import load_inference_bundle
 
+    # checkpoint-built engines always carry their model fingerprint —
+    # the AOT store key material (and what bench_serve profiles stamp);
+    # a caller-supplied one (tests faking skew) wins
+    kernels = engine_kwargs.get("kernels", "xla")
+    engine_kwargs.setdefault("engine_fingerprint", engine_fingerprint(
+        model_arch=model_arch,
+        image_size=image_size,
+        model_widths=model_widths,
+        s2d_levels=s2d_levels,
+        quantize=quantize,
+        kernels=getattr(kernels, "name", None) or str(kernels),
+    ))
     bundle = load_inference_bundle(
         checkpoint, checkpoint_dir=checkpoint_dir, image_size=image_size,
         model_arch=model_arch, model_widths=model_widths,

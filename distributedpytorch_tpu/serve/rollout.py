@@ -230,10 +230,22 @@ class RolloutManager:
         self.last_reason = reason
         obsm.SERVE_ROLLOUTS.labels(outcome=outcome).inc()
         obsm.SERVE_ROLLOUT_CANARY.set(0)
+        # the AOT invariant the hot-swap design rests on: weights are
+        # executable ARGUMENTS, so a rollout — load, canary, promote or
+        # roll back — performs ZERO recompiles (the engine's bucket
+        # executables, store-loaded or not, keep serving). Stamped into
+        # the transition log + flight ring so a recompile ever showing
+        # up here reads as the regression it is.
+        fields.setdefault(
+            "recompiles",
+            getattr(self.engine, "aot_compiles", 0)
+            - getattr(self, "_compiles_at_start", 0),
+        )
         self._transition(STATE_IDLE, outcome=outcome, reason=reason,
                          **fields)
 
     def _run(self, source, label: str) -> None:
+        self._compiles_at_start = getattr(self.engine, "aot_compiles", 0)
         self._transition(STATE_LOADING, label=label)
         try:
             params, model_state = self._load(source)

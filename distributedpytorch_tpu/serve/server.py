@@ -799,6 +799,7 @@ class Server:
                 # (and the legacy/env fallbacks) gate engagement exactly
                 # like training — the engine accepts a resolved policy
                 kernels=get_kernel_policy(cfg),
+                aot_cache=getattr(cfg, "aot_cache", None),
             )
         kwargs = dict(
             slo_ms=cfg.slo_ms,
@@ -843,5 +844,9 @@ class Server:
             "attribution": self.tracer.snapshot_attribution(
                 exemplars=self.metrics.p99_exemplars()
             ),
+            # AOT executable store (utils/aotstore.py): this engine
+            # build's cold-start story — hit/miss/skew per bucket
+            # executable, plus how many compiles actually ran
+            "aot_cache": self.engine.aot_cache_stats,
         })
         return snap

@@ -442,6 +442,9 @@ class TestStatsSchema:
         # request-tracing addition (ISSUE 13, deliberate schema growth):
         # per-phase tail-latency attribution + SLO burn + p99 exemplars
         "attribution",
+        # AOT executable store addition (ISSUE 16, deliberate schema
+        # growth): this engine build's cold-start hit/miss/skew story
+        "aot_cache",
     }
 
     def test_stats_key_set_and_types_pinned(self, engine):
@@ -473,6 +476,15 @@ class TestStatsSchema:
                 "device_exec", "drain",
             }
             assert attribution["completed"] >= 1
+            # the aot_cache block's own pinned sub-schema; the engine
+            # fixture arms no store, so it reports disabled with the
+            # compiles it actually performed
+            aot = stats["aot_cache"]
+            assert set(aot) == {
+                "enabled", "dir", "hit", "miss", "skew", "compiles",
+            }
+            assert aot["enabled"] is False
+            assert aot["compiles"] == len(stats["buckets"])
             json.dumps(stats)  # JSON-serializable end to end
         finally:
             server.stop()

@@ -266,6 +266,23 @@ def order_by_plan(todo, plan_ranks: dict):
     return ranked + [t for t in todo if t[0] not in plan_ranks]
 
 
+def _aot_counters() -> dict:
+    """Process-wide dpt_aot_cache_total values (the bench runs every
+    leg in ONE process, so per-leg deltas are exact)."""
+    from distributedpytorch_tpu.obs import defs as obsm
+
+    return {k: int(v) for k, v in obsm.AOT_CACHE.as_dict().items()}
+
+
+def _aot_delta(before: dict) -> dict:
+    """Per-leg AOT store provenance: how many of this leg's executables
+    loaded vs compiled (a $DPT_AOT_CACHE-armed window's later legs
+    should be all-hit; all zeros = store unarmed)."""
+    now = _aot_counters()
+    return {k: now.get(k, 0) - before.get(k, 0)
+            for k in ("hit", "miss", "skew")}
+
+
 def _plan_provenance(plan_ranks: dict, name: str) -> dict:
     info = plan_ranks.get(name)
     if not info:
@@ -655,6 +672,7 @@ def _run_configs(args, todo, bench, _probe_once, plan_ranks=None) -> int:
                                "budget_s": budget,
                                **_plan_provenance(plan_ranks, name)})
         dog = _arm_config_watchdog(args.out, name, budget)
+        aot_before = _aot_counters()
         try:
             result = _run_one(bench, name, env, budget)
         except Exception as exc:  # noqa: BLE001 — classified below
@@ -728,6 +746,7 @@ def _run_configs(args, todo, bench, _probe_once, plan_ranks=None) -> int:
         append_line(args.out, {
             "config": name, **result,
             "flight_recorder": flight_artifact_path(args.out, name),
+            "aot_cache": _aot_delta(aot_before),
             **_plan_provenance(plan_ranks, name),
         })
         print(json.dumps({"config": name, **result}))

@@ -106,7 +106,12 @@ def build_engine(args):
     )
     model, init_fn = create_model(cfg)
     params, model_state = init_fn(jax.random.key(args.seed), (h, w))
-    return ServeEngine(model, params, model_state, input_hw=(h, w), **common)
+    # fresh-init engines carry the bench identity fingerprint so a
+    # $DPT_AOT_CACHE-armed window stops re-paying identical compiles
+    # across legs (the engine resolves the store dir from the env)
+    return ServeEngine(model, params, model_state, input_hw=(h, w),
+                       engine_fingerprint=_engine_fingerprint(args),
+                       **common)
 
 
 def make_images(n: int, hw, seed: int = 0) -> np.ndarray:

@@ -167,7 +167,8 @@ def _serve_and_fleet_smoke() -> int:
                        "dpt_serve_device_exec_seconds",
                        "dpt_serve_slo_burn_fast",
                        "dpt_serve_slo_burn_slow",
-                       "dpt_serve_slow_requests_total"):
+                       "dpt_serve_slow_requests_total",
+                       "dpt_aot_cache_total"):
             if family not in serve_fams:
                 raise SystemExit(f"no {family} in the serve /metrics")
         stats = json.loads(urllib.request.urlopen(

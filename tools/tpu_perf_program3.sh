@@ -34,6 +34,15 @@ OUT="$(cd "$OUT" && pwd)"
 PRIORS="$OUT/kernel_priors.json"
 export DPT_KERNEL_PRIORS="$PRIORS"
 
+# Shared AOT executable store (utils/aotstore.py, docs/PERFORMANCE.md
+# "AOT executable store"): serve-shaped legs within — and across —
+# invocations of this window load their bucket executables instead of
+# re-paying identical compiles; each bench_multi leg row stamps its
+# hit/miss/skew delta as provenance. Version/identity-skewed entries
+# refuse loudly and recompile, so a stale outdir can never serve a
+# wrong program.
+export DPT_AOT_CACHE="$OUT/aot_cache"
+
 # Auto-planner plan (docs/PERFORMANCE.md "Planning"): rank the window's
 # legs by predicted win BEFORE touching the chip. The planner runs on a
 # self-provisioned CPU mesh (zero chip involvement — safe even while
