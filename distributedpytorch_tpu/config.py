@@ -446,10 +446,29 @@ class ServeConfig:
     watch_checkpoint: Optional[str] = None
     watch_poll_s: float = 2.0
 
-    # -- autoscale hint (serve/autoscale.py; recommendation only) -----------
+    # -- autoscale (serve/autoscale.py hint + serve/scaler.py actuator) -----
     # Cadence of the replica-count recommendation (gauge + log line)
     # from queue-depth/shed hysteresis. 0 = off.
     autoscale_interval_s: float = 30.0
+    # ACT on the hint: grow/shrink the live replica group through
+    # Server.resize_replicas (AOT-store-backed, no restart). Requires
+    # the hint (autoscale_interval_s > 0); off by default — actuation
+    # is opt-in, the hint alone is free.
+    autoscale_act: bool = False
+    # dpt_serve_plan artifact (analysis/serve_planner.py plan-serve):
+    # every scale decision cites the grid point it executes. None =
+    # decisions still happen, cited as plan_point=None.
+    serve_plan: Optional[str] = None
+    # Actuation bounds + anti-flap cooldown (None = the hint's own
+    # hysteresis window count).
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None
+    scale_cooldown_windows: Optional[int] = None
+
+    # -- sustained A/B (serve/rollout.py ABTest; POST /admin/ab) ------------
+    # Arm "b" traffic fraction when an A/B starts without an explicit
+    # split in the request body.
+    ab_split: float = 0.5
 
     # -- request tracing (obs/reqtrace.py, docs/OBSERVABILITY.md) -----------
     # End-to-end "good request" latency bound the SLO burn-rate windows

@@ -172,12 +172,16 @@ class FleetMetricsScraper:
     would read as a healthy flatline."""
 
     def __init__(self, host: str, base_port: int, world_fn,
-                 interval_s: float = 2.0, timeout_s: float = 2.0):
+                 interval_s: float = 2.0, timeout_s: float = 2.0,
+                 on_sweep=None):
         self.host = host
         self.base_port = int(base_port)
         self.world_fn = world_fn  # () -> current world size
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
+        # per-sweep subscriber (the router's placement feed): called
+        # with the {rank: exposition_text} of each completed sweep
+        self.on_sweep = on_sweep
         self._latest: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -224,6 +228,12 @@ class FleetMetricsScraper:
             seen = self.scrape_once()
             with self._lock:
                 self._latest = seen
+            if self.on_sweep is not None:
+                try:
+                    self.on_sweep(seen)
+                except Exception:  # noqa: BLE001 — a subscriber must
+                    # not kill the pane
+                    logger.exception("fleet scrape: on_sweep failed")
             if self._stop.wait(self.interval_s):
                 return
 
@@ -282,6 +292,7 @@ class ElasticSupervisor:
         trace: bool = True,
         metrics_port: Optional[int] = None,
         workload: str = "train",
+        router_port: Optional[int] = None,
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -334,6 +345,13 @@ class ElasticSupervisor:
         # fleet pane (serve workload + --metrics-port): the per-worker
         # /metrics scraper feeding the supervisor's merged exposition
         self.fleet_scraper: Optional[FleetMetricsScraper] = None
+        # front door (serve workload + --router-port): ONE address
+        # proxying /predict across the workers with load-aware
+        # placement, transparent retry of 503s/dead workers, and
+        # /admin/ab fan-out (serve/router.py — jax-free, runs in this
+        # process). None = clients talk to worker ports directly.
+        self.router_port = router_port
+        self.router = None
 
         # resume coordinates, parsed from the worker argv (the trainer's
         # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt).
@@ -423,10 +441,16 @@ class ElasticSupervisor:
             env["JAX_COMPILATION_CACHE_DIR"] = f"{prefix}_rank{rank}"
         return env
 
-    def _worker_argv(self, attempt: int, rank: int = 0) -> List[str]:
+    def _worker_argv(self, attempt: int, rank: int = 0,
+                     hb_attempt: Optional[int] = None) -> List[str]:
+        # hb_attempt pins the heartbeat/timeline directory independently
+        # of the flag-selecting attempt index: a serve worker relaunched
+        # IN PLACE (attempt > 0 flags, so chaos specs are not re-armed)
+        # must keep beating where its surviving siblings still beat
+        hb = attempt if hb_attempt is None else hb_attempt
         argv = self.worker_cmd + self.worker_args
         argv += [
-            "--heartbeat-dir", self._hb_dir(attempt),
+            "--heartbeat-dir", self._hb_dir(hb),
             "--heartbeat-interval", str(self.heartbeat_interval_s),
         ]
         if self.trace:
@@ -435,7 +459,7 @@ class ElasticSupervisor:
             # writes per-request span ledgers under the same convention)
             # — merged after the run by the trace hub into one
             # rank/worker-disambiguated Perfetto timeline
-            argv += ["--trace-timeline", self._timeline_base(attempt)]
+            argv += ["--trace-timeline", self._timeline_base(hb)]
         if attempt == 0:
             for spec in self.chaos:
                 argv += ["--inject-fault", spec]
@@ -562,6 +586,39 @@ class ElasticSupervisor:
                 f.close()
             except OSError:
                 pass
+
+    def _relaunch_rank(self, rank: int, attempt: int) -> None:
+        """Replace ONE failed serve worker in place — the collective-free
+        fleet's siblings keep serving the whole time. Heartbeats and
+        timelines stay pinned to the attempt-0 directories (the
+        survivors are still writing there); ``attempt`` only selects
+        argv flags, so chaos specs are never re-armed on a relaunch."""
+        old = self._procs[rank]
+        if old.poll() is None:  # hung, not dead: stop it first
+            try:
+                old.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            deadline = time.monotonic() + self.teardown_grace_s
+            while time.monotonic() < deadline and old.poll() is None:
+                time.sleep(0.05)
+            if old.poll() is None:
+                old.kill()
+        old.wait()
+        log_f = open(self._log_path(0, rank), "ab")
+        self._log_files.append(log_f)
+        try:
+            self._procs[rank] = subprocess.Popen(
+                self._worker_argv(attempt, rank, hb_attempt=0),
+                env=self._worker_env(rank, len(self._procs),
+                                     _free_port(), 0),
+                cwd=self.cwd,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+            )
+        except Exception:
+            self._teardown()
+            raise
 
     def request_stop(self) -> None:
         """Ask a running supervision loop to stop cleanly: tear down the
@@ -715,6 +772,33 @@ class ElasticSupervisor:
                 return STATIC_CHECK_EXIT
         metrics_server = None
         fleet_scraper = None
+        router_httpd = None
+        if self.workload == "serve" and self.router_port is not None:
+            # the front door: one address, load-aware placement over
+            # worker ports base+R, transparent retry of sheds and
+            # SIGKILLed workers (a relaunching worker is a retried
+            # sibling, not a client-visible failure)
+            from distributedpytorch_tpu.serve.router import (
+                Router,
+                make_router_http,
+            )
+
+            host = _worker_arg(self.worker_args, ("--host",), "127.0.0.1")
+            self.router = Router(
+                [(host, self.base_port + r) for r in range(self.nprocs)]
+            ).start()
+            router_httpd = make_router_http(
+                self.router, host=host, port=self.router_port,
+            )
+            threading.Thread(
+                target=router_httpd.serve_forever, daemon=True,
+                name="dpt-router-http",
+            ).start()
+            logger.info(
+                "elastic: router front door on http://%s:%d over %d "
+                "worker(s) — POST /predict, POST /admin/ab, GET /stats",
+                host, router_httpd.server_address[1], self.nprocs,
+            )
         if self.metrics_port is not None:
             from distributedpytorch_tpu.obs.http import start_metrics_server
 
@@ -735,6 +819,11 @@ class ElasticSupervisor:
                     host, self.base_port,
                     lambda: (self.world_history[-1]
                              if self.world_history else self.nprocs),
+                    # the router places off the SAME per-worker numbers
+                    # this pane collects: each sweep feeds it queue
+                    # depths (and marks non-answering workers stale)
+                    on_sweep=(self.router.ingest_fleet_metrics
+                              if self.router is not None else None),
                 ).start()
                 self.fleet_scraper = fleet_scraper
 
@@ -751,6 +840,8 @@ class ElasticSupervisor:
                         " (fleet pane: merged worker-labeled families)"
                         if fleet_scraper is not None else "")
         try:
+            if self.workload == "serve":
+                return self._run_supervised_serve()
             return self._run_supervised()
         except KeyboardInterrupt:
             # the serve workload's normal exit (fleets run until told
@@ -764,8 +855,123 @@ class ElasticSupervisor:
         finally:
             if fleet_scraper is not None:
                 fleet_scraper.stop()
+            if router_httpd is not None:
+                router_httpd.shutdown()
+            if self.router is not None:
+                self.router.stop()
             if metrics_server is not None:
                 metrics_server.close()
+
+    def _run_supervised_serve(self) -> int:
+        """Supervision for the collective-free serve fleet: a failed
+        worker is relaunched ALONE, in place, while its siblings keep
+        serving — behind the router front door the relaunch gap is a
+        retried sibling, never a fleet-wide outage. Training keeps the
+        whole-world restart (``_run_supervised``): a torn collective
+        cannot be healed per rank. The restart budget counts relaunch
+        WAVES (one wave may replace several workers), and the attempt
+        ledger records one failed entry per wave so reports read the
+        same as training's. The world never shrinks here — serve
+        capacity is the replica scaler's lever, not the supervisor's."""
+        world = self.nprocs
+        attempt = 0
+        self.world_history.append(world)
+        obsm.ELASTIC_WORLD_SIZE.set(world)
+        t0 = time.monotonic()
+        self._spawn(0, world)
+        started_at = time.time()
+        # a just-relaunched worker's stale beat (or missing beat while
+        # it re-warms off the AOT store) must not read as a new death
+        grace_until: Dict[int, float] = {}
+        while True:
+            if self._shutdown.is_set():
+                codes = self._exit_codes()
+                self._teardown()
+                self.attempts.append(AttemptResult(
+                    attempt=attempt, world=world, ok=True, failures=[],
+                    exit_codes=codes,
+                    duration_s=time.monotonic() - t0,
+                ))
+                self._merge_timelines()
+                self._write_report(final="stopped")
+                logger.info(
+                    "elastic serve fleet stopped on request: %d "
+                    "relaunch wave(s), world %d", self.restarts, world,
+                )
+                return 0
+            codes = self._exit_codes()
+            verdicts = self._classify(0, world, started_at)
+            now = time.time()
+            failed: Dict[int, health.RankHealth] = {}
+            for r in range(world):
+                alive = codes.get(r) is None
+                if alive and now < grace_until.get(r, 0.0):
+                    continue
+                # ANY exit is a failure here: a serve worker runs until
+                # the supervisor says stop, even exit 0 means capacity
+                # silently left the fleet
+                if verdicts[r].failed or not alive:
+                    failed[r] = verdicts[r]
+            if not failed:
+                time.sleep(self.poll_interval_s)
+                continue
+            lines = health.format_failures(
+                {r: verdicts[r] for r in failed}
+            )
+            for r in sorted(failed):
+                if not verdicts[r].failed:
+                    lines.append(
+                        f"rank {r}: dead (exited {codes.get(r)} — a "
+                        "serve worker runs until stopped)"
+                    )
+            self.attempts.append(AttemptResult(
+                attempt=attempt, world=world, ok=False, failures=lines,
+                exit_codes=codes, duration_s=time.monotonic() - t0,
+            ))
+            obsm.ELASTIC_ATTEMPTS.labels(outcome="failed").inc()
+            for r, h in failed.items():
+                obsm.ELASTIC_RANK_FAILURES.labels(
+                    failure_class=h.state
+                ).inc()
+                flight.record("rank_failure", rank=r, state=h.state,
+                              epoch=h.epoch, step=h.step)
+            for line in lines:
+                logger.error("%s", line)
+            if self.restarts >= self.max_restarts:
+                self._teardown()
+                self._merge_timelines()
+                self._write_report(final="failed")
+                flight.dump(
+                    "elastic_budget_exhausted",
+                    path=os.path.join(self.run_dir,
+                                      "flight_supervisor.json"),
+                    extra={"failures": lines,
+                           "world_history": self.world_history},
+                )
+                logger.error(
+                    "elastic serve fleet failed: restart budget (%d) "
+                    "exhausted; per-rank logs under %s",
+                    self.max_restarts, self.run_dir,
+                )
+                return 1
+            self.restarts += 1
+            obsm.ELASTIC_RESTARTS.inc()
+            attempt += 1
+            t0 = time.monotonic()
+            backoff = self.restart_backoff_s * (2.0 ** (self.restarts - 1))
+            logger.warning(
+                "elastic serve: relaunching worker(s) %s in place "
+                "(restart %d/%d; siblings keep serving) in %.1fs",
+                sorted(failed), self.restarts, self.max_restarts, backoff,
+            )
+            if self._shutdown.wait(backoff):
+                continue
+            for r in sorted(failed):
+                self._relaunch_rank(r, attempt)
+                grace_until[r] = time.time() + max(
+                    self.spawn_timeout_s, self.heartbeat_timeout_s
+                )
+            self._write_report(final=None)
 
     def _run_supervised(self) -> int:
         world = self.nprocs
@@ -959,6 +1165,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "/metrics scraped and re-exposed merged with "
                          "worker=\"R\" labels (one scrape target for "
                          "the whole fleet)")
+    ap.add_argument("--router-port", type=int, default=None,
+                    help="With --workload serve: front the fleet on ONE "
+                         "address — an HTTP router proxying /predict "
+                         "across the workers with load-aware placement, "
+                         "transparent retry of 503s and dead workers, "
+                         "and POST /admin/ab fan-out (serve/router.py)")
     ap.add_argument("worker_args", nargs=argparse.REMAINDER,
                     help="Training CLI args (prefix with --)")
     args = ap.parse_args(argv)
@@ -989,6 +1201,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         trace=not args.no_trace,
         metrics_port=args.metrics_port,
         workload=args.workload,
+        router_port=args.router_port,
     )
     return sup.run()
 

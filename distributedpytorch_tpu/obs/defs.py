@@ -109,7 +109,23 @@ SERVE_ROLLOUT_CANARY = REGISTRY.gauge(
 SERVE_REPLICA_HINT = REGISTRY.gauge(
     "dpt_serve_replica_hint",
     "Recommended replica count from queue-depth/shed hysteresis "
-    "(recommendation only — serve/autoscale.py)")
+    "(the signal serve/scaler.py actuates)")
+SERVE_REPLICAS = REGISTRY.gauge(
+    "dpt_serve_replicas",
+    "Live replica-group size (moved without restart by the "
+    "autoscaler — serve/scaler.py)")
+SERVE_SCALE_EVENTS = REGISTRY.counter(
+    "dpt_serve_scale_events_total",
+    "Autoscaler actuations on the live replica group, each citing the "
+    "plan-serve grid point it executes", ("direction",))
+SERVE_AB_REQUESTS = REGISTRY.counter(
+    "dpt_serve_ab_requests_total",
+    "Sustained-A/B requests by arm and resolution (server-side view; "
+    "the router's ledger discards hedge losers)", ("arm", "status"))
+SERVE_AB_ACTIVE = REGISTRY.gauge(
+    "dpt_serve_ab_active",
+    "1 while a sustained A/B pins two weight versions to disjoint "
+    "replica groups, else 0")
 AOT_CACHE = REGISTRY.counter(
     "dpt_aot_cache_total",
     "AOT executable store events (utils/aotstore.py): hit = loaded a "
@@ -149,6 +165,28 @@ SERVE_SLO_BURN_FAST = REGISTRY.gauge(
 SERVE_SLO_BURN_SLOW = REGISTRY.gauge(
     "dpt_serve_slo_burn_slow",
     "Error-budget burn rate over the slow window")
+
+# -- router front door (recorded by serve/router.py; jax-free) --------------
+ROUTER_REQUESTS = REGISTRY.counter(
+    "dpt_router_requests_total",
+    "Front-door requests by final client-visible HTTP code (transparent "
+    "retries collapse into one row here)", ("code",))
+ROUTER_RETRIES = REGISTRY.counter(
+    "dpt_router_retries_total",
+    "Transparent resubmissions to a sibling worker "
+    "(connection = dead worker ejected mid-request, shed = 503 honored)",
+    ("reason",))
+ROUTER_HEDGES = REGISTRY.counter(
+    "dpt_router_hedges_total",
+    "Hedged duplicate requests past the p99 deadline, by which copy "
+    "answered the client (primary/hedge) — the loser is cancelled and "
+    "never counted as a request", ("winner",))
+ROUTER_WORKER_EVENTS = REGISTRY.counter(
+    "dpt_router_worker_events_total",
+    "Worker-pool transitions (eject on connection failure, readmit on "
+    "/healthz readiness, stale on a missed stats scrape)", ("event",))
+ROUTER_HEALTHY_WORKERS = REGISTRY.gauge(
+    "dpt_router_healthy_workers", "Workers currently in the routable pool")
 
 # -- elastic supervisor (recorded by dist/elastic.py; jax-free) -------------
 ELASTIC_RESTARTS = REGISTRY.counter(

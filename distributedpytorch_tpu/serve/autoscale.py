@@ -1,14 +1,14 @@
-"""Replica autoscale *hint*: a recommendation, never an action.
+"""Replica autoscale *hint*: the recommendation half of autoscaling.
 
 The Prometheus gauges the serve tier already exports (PR 7: queue
 depth, shed counts, throughput) contain the capacity answer; this
-module reads them on a fixed cadence and publishes what a human — or a
-future autoscaler — should do about it: the
-``dpt_serve_replica_hint`` gauge plus one log line whenever the
-recommendation changes. Actual autoscaling (resizing the replica set,
-re-AOT-compiling buckets on new devices) stays future work
-(ROADMAP.md); this layer exists so the signal is already proven and
-dashboarded when it lands.
+module reads them on a fixed cadence and publishes what to do about
+it: the ``dpt_serve_replica_hint`` gauge plus one log line whenever
+the recommendation changes. ACTING on the hint — growing or retiring
+live replica groups against the plan-serve grid — is
+``serve/scaler.py``'s job; this layer stays a pure signal so the
+policy is unit-testable without devices and dashboards keep working
+when the scaler is off.
 
 Hysteresis, not thresholds: one shed burst must not flap the
 recommendation. Scale-up needs ``up_windows`` consecutive windows with
@@ -76,11 +76,21 @@ class AutoscaleHint:
         obsm.SERVE_REPLICA_HINT.set(self.recommendation)
 
     # -- the policy (pure per-window; unit-testable without threads) ---------
-    def observe_window(self, shed_delta: int, max_depth: int) -> int:
-        """Fold one window's observations into the recommendation."""
+    def observe_window(self, shed_delta: int, max_depth: int,
+                       stale: bool = False) -> int:
+        """Fold one window's observations into the recommendation.
+
+        ``stale`` closes the hint's blind spot: ``shed_delta`` and
+        ``max_depth`` only describe workers that ANSWERED the last
+        scrape, so a wedged worker used to read as absence of pressure
+        — exactly when its siblings are absorbing its load. A stale
+        window counts as pressure (a worker we cannot see is a worker
+        we must assume is drowning) and can never count as quiet."""
         replicas = self.server.engine.num_replicas
-        pressured = shed_delta > 0 or max_depth >= self.depth_high
-        quiet = shed_delta == 0 and max_depth == 0
+        pressured = (
+            bool(stale) or shed_delta > 0 or max_depth >= self.depth_high
+        )
+        quiet = not stale and shed_delta == 0 and max_depth == 0
         self._up_streak = self._up_streak + 1 if pressured else 0
         self._down_streak = self._down_streak + 1 if quiet else 0
         if self._up_streak >= self.up_windows:
@@ -93,11 +103,11 @@ class AutoscaleHint:
             logger.info(
                 "serve autoscale hint: recommend %d replica(s) "
                 "(serving with %d) — %s over the last window(s) "
-                "(shed=%d, max_depth=%d, cap=%d); recommendation only, "
-                "no action taken",
+                "(shed=%d, max_depth=%d, stale=%s, cap=%d); the hint is "
+                "a signal — serve/scaler.py is the actuator",
                 rec, replicas,
                 "sustained pressure" if rec > replicas else "sustained idle",
-                shed_delta, max_depth, self.depth_high,
+                shed_delta, max_depth, bool(stale), self.depth_high,
             )
         self.recommendation = rec
         obsm.SERVE_REPLICA_HINT.set(rec)

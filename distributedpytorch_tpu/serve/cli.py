@@ -30,6 +30,13 @@ layer exists for. Endpoints:
   checkpoint into the running engine through the canary state machine
   (serve/rollout.py) — 202 accepted, 409 if one is already in flight.
   ``GET`` returns the rollout status.
+* ``POST /admin/ab`` — sustained weight A/B (serve/rollout.py:ABTest):
+  ``{"action": "start", "checkpoint": ..., "split": 0.5}`` pins the
+  candidate to half the replica groups; ``{"action": "verdict"}``
+  returns per-arm latency/shed + inter-arm Dice; ``{"action": "stop",
+  "winner": "a"|"b"}`` promotes the winner fleet-wide. ``GET`` returns
+  the A/B status. Behind a router (serve/router.py) the same route
+  fans out to every worker.
 
 Example:
     python -m distributedpytorch_tpu serve -c singleGPU --port 8008 \\
@@ -164,8 +171,25 @@ def get_args(argv=None):
                         help="Checkpoint-watch poll cadence (seconds)")
     parser.add_argument("--autoscale-interval", type=float, default=30.0,
                         help="Cadence of the replica-count "
-                             "recommendation (gauge + log line; "
-                             "recommendation only). 0 = off")
+                             "recommendation (gauge + log line). 0 = off")
+    parser.add_argument("--autoscale-act", action="store_true",
+                        help="ACT on the replica hint: grow/shrink the "
+                             "live replica group without a restart "
+                             "(serve/scaler.py; needs --autoscale-"
+                             "interval > 0)")
+    parser.add_argument("--serve-plan", type=str, default=None,
+                        metavar="PLAN_JSON",
+                        help="plan-serve artifact (dpt_serve_plan): "
+                             "each scale decision cites the grid point "
+                             "it executes")
+    parser.add_argument("--min-replicas", type=int, default=1,
+                        help="Autoscaler floor")
+    parser.add_argument("--max-replicas", type=int, default=None,
+                        help="Autoscaler ceiling (default: the devices "
+                             "present)")
+    parser.add_argument("--ab-split", type=float, default=0.5,
+                        help="Default arm-b traffic fraction for "
+                             "POST /admin/ab starts")
     parser.add_argument("--latency-slo-ms", type=float, default=None,
                         help="End-to-end good-request latency bound for "
                              "the SLO burn-rate gauges (default 2x "
@@ -245,6 +269,11 @@ def to_config(args):
         watch_checkpoint=args.watch_checkpoint,
         watch_poll_s=args.watch_poll,
         autoscale_interval_s=args.autoscale_interval,
+        autoscale_act=args.autoscale_act,
+        serve_plan=args.serve_plan,
+        min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas,
+        ab_split=args.ab_split,
         latency_slo_ms=args.latency_slo_ms,
         slow_request_ms=args.slow_request_ms,
         trace_timeline=args.trace_timeline,
@@ -298,11 +327,13 @@ def build_server(args):
 
 
 def attach_fleet(server, cfg) -> None:
-    """Wire the rollout manager, checkpoint watcher, and autoscale hint
-    onto a built server (split out so tests and the bench can attach to
-    servers they construct directly). Components start with the server
-    and stop with ``server.stop()``."""
+    """Wire the rollout manager, checkpoint watcher, sustained-A/B
+    controller, autoscale hint, and — when opted into — the replica
+    scaler onto a built server (split out so tests and the bench can
+    attach to servers they construct directly). Components start with
+    the server and stop with ``server.stop()``."""
     from distributedpytorch_tpu.serve.rollout import (
+        ABTest,
         CheckpointWatcher,
         RolloutManager,
     )
@@ -316,6 +347,12 @@ def attach_fleet(server, cfg) -> None:
         window_s=cfg.rollout_window_s,
         dice_margin=cfg.rollout_dice_margin,
         canary_replicas=cfg.canary_replicas,
+    )
+    # always attached (inert until POST /admin/ab start): sharing the
+    # rollout probe rows gives the verdict its inter-arm Dice half
+    server.abtest = ABTest(
+        server, probe_rows=probe_rows or None,
+        split=getattr(cfg, "ab_split", 0.5),
     )
     watch = cfg.watch_checkpoint
     if watch is not None:
@@ -333,6 +370,17 @@ def attach_fleet(server, cfg) -> None:
         server.autoscale = AutoscaleHint(
             server, interval_s=cfg.autoscale_interval_s
         ).start()
+        if getattr(cfg, "autoscale_act", False):
+            from distributedpytorch_tpu.serve.scaler import ReplicaScaler
+
+            server.scaler = ReplicaScaler(
+                server, server.autoscale,
+                plan=getattr(cfg, "serve_plan", None),
+                min_replicas=getattr(cfg, "min_replicas", 1),
+                max_replicas=getattr(cfg, "max_replicas", None),
+                cooldown_windows=getattr(cfg, "scale_cooldown_windows",
+                                         None),
+            ).start()
 
 
 def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
@@ -410,6 +458,13 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                                               "attached to this server"})
                 else:
                     self._json(200, manager.status())
+            elif self.path == "/admin/ab":
+                abtest = server.abtest
+                if abtest is None:
+                    self._json(404, {"error": "no A/B controller "
+                                              "attached to this server"})
+                else:
+                    self._json(200, abtest.status())
             elif self.path == "/metrics":
                 # burn gauges decay with their windows: re-derive at
                 # scrape time so a quiet worker's burn reads 0, not the
@@ -450,11 +505,62 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                 return
             self._json(202, {"accepted": True, "status": manager.status()})
 
+        def _admin_ab(self, body: bytes) -> None:
+            """Sustained A/B lifecycle (serve/rollout.py:ABTest) —
+            ``{"action": "start", "checkpoint": ..., "split": 0.5}`` /
+            ``{"action": "verdict"}`` / ``{"action": "stop",
+            "winner": "a"|"b"}``."""
+            from distributedpytorch_tpu.serve.rollout import (
+                RolloutInProgress,
+            )
+
+            abtest = server.abtest
+            if abtest is None:
+                self._json(404, {"error": "no A/B controller attached "
+                                          "to this server"})
+                return
+            try:
+                spec = json.loads(body or b"{}")
+                action = spec["action"]
+            except (ValueError, KeyError, TypeError):
+                self._json(400, {
+                    "error": 'body must be JSON with an "action" of '
+                             'start|verdict|stop',
+                })
+                return
+            try:
+                if action == "start":
+                    if "split" in spec:
+                        abtest.split = min(max(float(spec["split"]), 0.0),
+                                           1.0)
+                    status = abtest.start(
+                        spec["checkpoint"],
+                        label=str(spec.get("label", spec["checkpoint"])),
+                    )
+                    self._json(202, {"accepted": True, "status": status})
+                elif action == "verdict":
+                    self._json(200, abtest.verdict())
+                elif action == "stop":
+                    self._json(200, abtest.stop(spec.get("winner")))
+                else:
+                    self._json(400, {"error": f"unknown action "
+                                              f"{action!r}"})
+            except RolloutInProgress as exc:
+                self._json(409, {"error": str(exc),
+                                 "status": abtest.status()})
+            except KeyError as exc:
+                self._json(400, {"error": f"missing field {exc}"})
+            except (ValueError, RuntimeError) as exc:
+                self._json(409, {"error": str(exc)[:300]})
+
         def do_POST(self):  # noqa: N802
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             if self.path == "/admin/rollout":
                 self._admin_rollout(body)
+                return
+            if self.path == "/admin/ab":
+                self._admin_ab(body)
                 return
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
@@ -472,10 +578,14 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
                 self._json(400, {"error": "body is not a decodable image",
                                  "request_id": rid}, request_id=rid)
                 return
+            # router-stamped A/B arm (X-AB-Arm): with no header the
+            # server derives the SAME arm from the request id, so the
+            # stamp is an optimization + an invariant, not a requirement
+            arm = self.headers.get("X-AB-Arm", "")
             try:
-                response = server.submit(img, request_id=rid).result(
-                    timeout=request_timeout_s
-                )
+                response = server.submit(
+                    img, request_id=rid, arm=arm
+                ).result(timeout=request_timeout_s)
             except concurrent.futures.TimeoutError:
                 # a wedged request must get an HTTP answer, not a
                 # handler traceback + dropped connection

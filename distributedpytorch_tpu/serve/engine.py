@@ -208,8 +208,14 @@ class ServeEngine:
         """Store key for one bucket executable on one device. The
         on-device mask threshold is key material (it is baked into the
         trace); the device is too — each executable carries a
-        ``SingleDeviceSharding`` and deserializes pinned to it."""
-        from distributedpytorch_tpu.utils.aotstore import entry_key
+        ``SingleDeviceSharding`` and deserializes pinned to it. The
+        device component goes through ``device_key`` so
+        ``$DPT_AOT_KEY_SCHEME=kind`` can relax the full decorated
+        string to a kind+ordinal scheme that identical chips share."""
+        from distributedpytorch_tpu.utils.aotstore import (
+            device_key,
+            entry_key,
+        )
 
         h, w = self.input_hw
         return entry_key(
@@ -223,7 +229,7 @@ class ServeEngine:
             ),
             quantized=self.quantized,
             stateful=self.stateful,
-            device=str(device),
+            device=device_key(device),
         )
 
     @property
@@ -242,6 +248,40 @@ class ServeEngine:
     @property
     def num_replicas(self) -> int:
         return len(self.replicas)
+
+    # -- live replica-group scaling (serve/scaler.py drives this) ------------
+    def add_replica(self) -> Replica:
+        """Grow the replica group by one device — the autoscaler's grow
+        actuator. The new replica's weights come from replica 0's
+        device-resident tree (the host tree is not retained past
+        ``__init__``; a cross-device ``device_put`` re-homes it), so it
+        joins at the currently promoted version. With a warm AOT store
+        every bucket executable is a load, not a compile — which is
+        what makes in-process scale-up cheap enough to actuate."""
+        import jax
+
+        devices = jax.devices()
+        if self.num_replicas >= len(devices):
+            raise RuntimeError(
+                f"cannot grow past {len(devices)} device(s) "
+                f"(already at {self.num_replicas} replicas)"
+            )
+        src = self.replicas[0]
+        index = self.num_replicas
+        replica = self._build_replica(index, devices[index], src.variables)
+        replica.weights_version = src.weights_version
+        self.replicas.append(replica)
+        return replica
+
+    def retire_replica(self) -> Replica:
+        """Shrink the replica group by one — pops the highest-index
+        replica. The caller (``Server.resize_replicas``) must have
+        drained that replica's dispatch slots first; the device tree
+        and executables are simply dropped (executables stay in the AOT
+        store, so the next grow re-loads them)."""
+        if self.num_replicas <= 1:
+            raise RuntimeError("cannot retire the last replica")
+        return self.replicas.pop()
 
     # -- zero-downtime weight hot-swap (serve/rollout.py drives this) --------
     @property
@@ -299,6 +339,24 @@ class ServeEngine:
             # but never (new vars, old version), which would cache a
             # candidate's mask under the promoted version's key
             replica.weights_version = int(version)
+            replica.variables = vars_dev
+
+    def clone_weights(self, src_index: int,
+                      dst_indices: Sequence[int]) -> None:
+        """Copy one replica's device-resident weights (and version) onto
+        other replicas — a device-to-device ``device_put``, no disk, no
+        recompile. Same version-before-variables write order as
+        ``swap_weights``. The sustained-A/B stop path promotes the
+        winning arm's weights fleet-wide through this."""
+        import jax
+
+        src = self.replicas[int(src_index)]
+        for i in dst_indices:
+            replica = self.replicas[i]
+            if replica is src:
+                continue
+            vars_dev = jax.device_put(src.variables, replica.sharding)
+            replica.weights_version = src.weights_version
             replica.variables = vars_dev
 
     def restore_weights(self, saved: Dict[int, tuple]) -> None:

@@ -75,11 +75,28 @@ class ServeMetrics:
         self._pad_rows = 0
         self._real_rows = 0
         self._started_t = clock()
+        # sustained-A/B per-arm ledgers, keyed by arm ("a"/"b"); only
+        # armed requests land here, so the dict stays empty — and
+        # snapshot() stays un-grown — whenever no A/B is running
+        self._arms: Dict[str, dict] = {}
+
+    def _arm_state(self, arm: str) -> dict:
+        state = self._arms.get(arm)
+        if state is None:
+            state = {
+                "requests_ok": 0, "requests_failed": 0, "images_ok": 0,
+                "rejected": 0,
+                "latencies_s": collections.deque(
+                    maxlen=self._latencies_s.maxlen
+                ),
+            }
+            self._arms[arm] = state
+        return state
 
     # -- recording (completion workers + submit path) ------------------------
     def record_request(
         self, n_images: int, enqueue_t: float, dispatch_t: float,
-        done_t: float, request_id: str = "",
+        done_t: float, request_id: str = "", arm: str = "",
     ) -> None:
         with self._lock:
             self._latencies_s.append(done_t - enqueue_t)
@@ -87,15 +104,26 @@ class ServeMetrics:
             self._queue_s.append(dispatch_t - enqueue_t)
             self._images_ok += n_images
             self._requests_ok += 1
+            if arm:
+                state = self._arm_state(arm)
+                state["requests_ok"] += 1
+                state["images_ok"] += n_images
+                state["latencies_s"].append(done_t - enqueue_t)
         obsm.SERVE_REQUESTS.labels(status="ok").inc()
         obsm.SERVE_IMAGES.inc(n_images)
         obsm.SERVE_LATENCY.observe(done_t - enqueue_t)
         obsm.SERVE_QUEUE_SECONDS.observe(dispatch_t - enqueue_t)
+        if arm:
+            obsm.SERVE_AB_REQUESTS.labels(arm=arm, status="ok").inc()
 
-    def record_failure(self) -> None:
+    def record_failure(self, arm: str = "") -> None:
         with self._lock:
             self._requests_failed += 1
+            if arm:
+                self._arm_state(arm)["requests_failed"] += 1
         obsm.SERVE_REQUESTS.labels(status="failed").inc()
+        if arm:
+            obsm.SERVE_AB_REQUESTS.labels(arm=arm, status="failed").inc()
 
     def record_cached(self, n_images: int) -> None:
         """A prediction-cache hit answered without touching the queue —
@@ -105,10 +133,35 @@ class ServeMetrics:
             self._requests_cached += 1
         obsm.SERVE_REQUESTS.labels(status="cached").inc()
 
-    def record_rejection(self, reason: str) -> None:
+    def record_rejection(self, reason: str, arm: str = "") -> None:
         with self._lock:
             self._rejections[reason] = self._rejections.get(reason, 0) + 1
+            if arm:
+                self._arm_state(arm)["rejected"] += 1
         obsm.SERVE_REJECTIONS.labels(reason=reason).inc()
+        if arm:
+            obsm.SERVE_AB_REQUESTS.labels(arm=arm, status="rejected").inc()
+
+    def ab_snapshot(self) -> Dict[str, dict]:
+        """Per-arm aggregates for the A/B verdict (``/admin/ab``):
+        latency percentiles over each arm's own window plus exact
+        ok/failed/shed counters. Empty dict when nothing is armed."""
+        with self._lock:
+            arms = {
+                arm: (dict(state), list(state["latencies_s"]))
+                for arm, state in self._arms.items()
+            }
+        out: Dict[str, dict] = {}
+        for arm, (state, lat) in sorted(arms.items()):
+            out[arm] = {
+                "requests_ok": state["requests_ok"],
+                "requests_failed": state["requests_failed"],
+                "images_ok": state["images_ok"],
+                "rejected": state["rejected"],
+                "p50_ms": round(percentile(lat, 50) * 1e3, 3) if lat else None,
+                "p99_ms": round(percentile(lat, 99) * 1e3, 3) if lat else None,
+            }
+        return out
 
     def record_dispatch(self, bucket: int, real_rows: int) -> None:
         with self._lock:

@@ -90,6 +90,11 @@ class ServeRequest:
     # scoped to; a canary/rollback in between must not poison the cache
     cache_key: Optional[str] = None
     cache_version: int = 0
+    # sustained-A/B arm ("" = unarmed): requests of different arms are
+    # answered by disjoint replica groups serving different weight
+    # versions, so a flushed batch must be arm-pure — the flush policy
+    # only ever considers the head same-arm run (see _poll_locked)
+    arm: str = ""
 
 
 class BatchingQueue:
@@ -178,9 +183,20 @@ class BatchingQueue:
         if not self._pending:
             return None
         now = self.clock()
+        # arm-pure batching: the policy only sees the head same-arm run,
+        # so a flush can never mix requests bound for different A/B
+        # replica groups (one batch = one executable call = one weight
+        # version). With no A/B every arm is "" and this is the whole
+        # FIFO — bit-identical to the un-armed behavior.
+        head_arm = self._pending[0].arm
+        sizes: List[int] = []
+        for req in self._pending:
+            if req.arm != head_arm:
+                break
+            sizes.append(req.size)
         decision = policy.decide_flush(
             self.planner,
-            [req.size for req in self._pending],
+            sizes,
             self._pending[0].deadline_t,
             self._pending_images,
             now,

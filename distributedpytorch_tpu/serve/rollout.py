@@ -44,7 +44,8 @@ import logging
 import os
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -351,6 +352,247 @@ class RolloutManager:
                         f"baseline {baseline_dice:.4f} "
                         f"(margin {self.dice_margin:g})")
         return None
+
+
+AB_ARM_A = "a"
+AB_ARM_B = "b"
+
+
+def ab_arm_for(request_id: str, split: float) -> str:
+    """Deterministic request-id → A/B arm (crc32 split; ``split`` is
+    arm "b"'s traffic fraction). One function, run identically by the
+    router (to stamp ``X-AB-Arm``) and every worker (to arm unstamped
+    requests), so a request keeps its arm across retries, hedges, and
+    workers with zero shared state."""
+    h = zlib.crc32(str(request_id).encode("utf-8")) & 0xFFFFFFFF
+    return AB_ARM_B if (h / 2.0 ** 32) < float(split) else AB_ARM_A
+
+
+class ABTest:
+    """Sustained weight A/B over disjoint replica groups.
+
+    Where :class:`RolloutManager` is a *transient* judge (canary a few
+    seconds, then converge the fleet to one version), an A/B pins TWO
+    promoted versions side by side for as long as the experiment runs:
+    arm ``a`` keeps the incumbent weights on the first half of the
+    replica groups, arm ``b`` gets the candidate on the rest. Traffic
+    splits by a deterministic hash of the request id (``arm_for`` —
+    stable across processes, so the router and every worker agree on a
+    request's arm without coordination), the batching queue keeps
+    batches arm-pure (serve/queue.py), and the server's placement pins
+    each arm's batches to its own replica group
+    (``Server._claim_replica``). Per-arm Dice/latency/shed ledgers
+    accumulate in ``ServeMetrics`` until ``verdict()`` is asked.
+
+    Mixed versions automatically force the prediction cache to bypass
+    itself (engine ``versions_mixed``), and the autoscaler holds while
+    arms are pinned — resizing would tear a group boundary.
+
+    ``stop(winner=...)`` promotes the winning arm's weights fleet-wide
+    (a device-to-device pointer flip via ``engine.clone_weights``, zero
+    recompiles) and unpins the groups. A bare ``stop()`` — the
+    server-shutdown teardown path — just unpins.
+    """
+
+    def __init__(self, server,
+                 probe_rows: Optional[Sequence[np.ndarray]] = None,
+                 split: float = 0.5, clock=time.monotonic):
+        self.server = server
+        self.engine = server.engine
+        self.probe_rows = list(probe_rows) if probe_rows else []
+        # fraction of traffic routed to arm "b" (the candidate)
+        self.split = min(max(float(split), 0.0), 1.0)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self.active = False
+        self.label = ""
+        self.arms: Dict[str, List[int]] = {}
+        self.versions: Dict[str, int] = {}
+        self.started_t: Optional[float] = None
+        self.last_verdict: Optional[dict] = None
+        self.history: List[dict] = []
+
+    # -- deterministic request → arm split -----------------------------------
+    def arm_for(self, request_id: str) -> str:
+        """crc32-hash split: the SAME function runs in the router (to
+        stamp ``X-AB-Arm``) and in every worker (to arm unstamped
+        requests), so a request keeps its arm across retries, hedges,
+        and workers without any shared state."""
+        return ab_arm_for(request_id, self.split)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self, source, label: str = "") -> dict:
+        """Pin ``source`` (checkpoint path or ``(params, model_state)``
+        tuple) as arm "b" on the back half of the replica groups.
+        Synchronous — the load/swap happens off the serving path and
+        the arms are live when this returns."""
+        with self._lock:
+            if self.active:
+                raise RolloutInProgress("an A/B test is already running")
+            rollout = getattr(self.server, "rollout", None)
+            if rollout is not None and rollout.canarying:
+                raise RolloutInProgress(
+                    "a canaried rollout is in flight — one experiment "
+                    "owns the replica groups at a time"
+                )
+            n = self.engine.num_replicas
+            if n < 2:
+                raise ValueError(
+                    f"sustained A/B needs >= 2 replica groups to pin "
+                    f"disjoint arms (have {n}) — scale up first"
+                )
+            params, model_state = self._load(source)
+            a_idx = list(range(n - n // 2))
+            b_idx = list(range(n - n // 2, n))
+            version = self.engine.next_weights_version()
+            old = self.engine.snapshot_weights(b_idx)
+            # arms pin BEFORE the swap: from the first moment the groups
+            # can disagree, placement and batching already honor them
+            self.arms = {AB_ARM_A: a_idx, AB_ARM_B: b_idx}
+            self.versions = {
+                AB_ARM_A: self.engine.replicas[a_idx[0]].weights_version,
+                AB_ARM_B: version,
+            }
+            self.label = label or str(source)[:120]
+            self.server.ab_arms = {
+                AB_ARM_A: frozenset(a_idx), AB_ARM_B: frozenset(b_idx),
+            }
+            self.active = True
+            try:
+                self.engine.swap_weights(params, model_state,
+                                         version=version,
+                                         replica_indices=b_idx)
+            except BaseException as exc:  # noqa: BLE001 — swap_crash site
+                # + real device_put failures: unpin and restore, the
+                # incumbent never stopped serving
+                logger.exception("ab: candidate swap failed")
+                self.engine.restore_weights(old)
+                self._teardown_locked()
+                raise RuntimeError(
+                    f"A/B candidate swap failed: {str(exc)[:250]}"
+                ) from exc
+            self.started_t = self.clock()
+            obsm.SERVE_AB_ACTIVE.set(1)
+            self._record("start", label=self.label, version_b=version,
+                         arm_a=a_idx, arm_b=b_idx)
+            return self.status()
+
+    def verdict(self) -> dict:
+        """The live scorecard: per-arm request/latency/shed aggregates
+        from the server's A/B ledgers, plus — when probe rows were
+        pinned — the inter-arm Dice agreement of the two versions on
+        the same inputs (run straight off one replica per arm, no queue
+        capacity consumed)."""
+        with self._lock:
+            if not self.active:
+                return {"active": False, "last_verdict": self.last_verdict}
+            return self._verdict_locked()
+
+    def _verdict_locked(self) -> dict:
+        ab = self.server.metrics.ab_snapshot()
+        out = {
+            "active": True,
+            "label": self.label,
+            "split": self.split,
+            "elapsed_s": round(self.clock() - self.started_t, 3),
+            "arms": {
+                arm: {
+                    "replicas": list(idx),
+                    "weights_version": self.versions.get(arm),
+                    **ab.get(arm, {}),
+                }
+                for arm, idx in sorted(self.arms.items())
+            },
+        }
+        if self.probe_rows:
+            masks_a = self._probe_masks(self.arms[AB_ARM_A][0])
+            masks_b = self._probe_masks(self.arms[AB_ARM_B][0])
+            out["inter_arm_dice"] = round(float(np.mean([
+                mask_dice(ma, mb) for ma, mb in zip(masks_a, masks_b)
+            ])), 4)
+        return out
+
+    def stop(self, winner: Optional[str] = None) -> dict:
+        """End the experiment. ``winner`` "a"/"b" promotes that arm's
+        weights onto every replica group (pointer flip, no recompile,
+        no drain) before unpinning; None — the bare teardown
+        ``Server.stop()`` calls — leaves each group's weights as they
+        stand and just unpins."""
+        with self._lock:
+            if not self.active:
+                return {"active": False, "note": "no A/B running"}
+            if winner not in (None, AB_ARM_A, AB_ARM_B):
+                raise ValueError(f"winner must be 'a', 'b', or None "
+                                 f"(got {winner!r})")
+            final = self._verdict_locked()
+            if winner is not None:
+                src = self.arms[winner][0]
+                dst = [i for idx in self.arms.values() for i in idx]
+                self.engine.clone_weights(src, dst)
+                obsm.SERVE_WEIGHTS_VERSION.set(
+                    self.versions.get(winner, 0))
+            self._record("stop", winner=winner,
+                         version=self.versions.get(winner))
+            self._teardown_locked()
+            self.last_verdict = {**final, "active": False,
+                                 "winner": winner}
+            return {"stopped": True, "winner": winner, "verdict": final}
+
+    def status(self) -> dict:
+        return {
+            "active": self.active,
+            "label": self.label if self.active else None,
+            "split": self.split,
+            "arms": {
+                arm: {"replicas": list(idx),
+                      "weights_version": self.versions.get(arm)}
+                for arm, idx in sorted(self.arms.items())
+            } if self.active else None,
+            "metrics": self.server.metrics.ab_snapshot() or None,
+            "last_verdict": self.last_verdict,
+            "history": self.history[-10:],
+        }
+
+    # -- internals -----------------------------------------------------------
+    def _teardown_locked(self) -> None:
+        self.server.ab_arms = None
+        self.active = False
+        self.arms = {}
+        self.versions = {}
+        self.started_t = None
+        obsm.SERVE_AB_ACTIVE.set(0)
+
+    def _record(self, event: str, **fields) -> None:
+        entry = {"event": event, "t": time.time(), **fields}
+        self.history.append(entry)
+        del self.history[:-50]
+        flight.record("ab_test", **{k: v for k, v in entry.items()
+                                    if k != "t"})
+        logger.info("ab: %s %s", event,
+                    " ".join(f"{k}={v}" for k, v in fields.items()))
+
+    def _load(self, source) -> Tuple[object, object]:
+        if isinstance(source, tuple):
+            return source[0], source[1]
+        loader = self.engine.bundle_loader
+        if loader is None:
+            raise ValueError(
+                "this engine was built from raw arrays (no checkpoint "
+                "context) — pass a (params, model_state) tuple instead "
+                "of a checkpoint path"
+            )
+        bundle = loader(str(source))
+        return bundle.params, bundle.model_state
+
+    def _probe_masks(self, replica_index: int) -> List[np.ndarray]:
+        masks: List[np.ndarray] = []
+        chunk = self.engine.planner.max_size
+        for i in range(0, len(self.probe_rows), chunk):
+            batch = np.stack(self.probe_rows[i:i + chunk])
+            out = self.engine.infer(batch, replica_index=replica_index)
+            masks.extend(self.engine.postprocess(out[j])
+                         for j in range(out.shape[0]))
+        return masks
 
 
 class CheckpointWatcher:

@@ -1,0 +1,685 @@
+"""One front door for the fleet: a retrying, load-aware HTTP router.
+
+The elastic supervisor (dist/elastic.py) already makes a dead serve
+worker a *relaunch* instead of an outage — but clients still had to
+know every worker's port and implement their own retry dance against
+``503 + Retry-After``. This module closes that gap with the classic
+tail-tolerance toolkit (Dean & Barroso, "The Tail at Scale", CACM '13):
+
+* **Load-aware placement** — each proxied request goes to the worker
+  with the lowest score = router-local in-flight + last-scraped queue
+  depth (a stale scrape reads as pressure, not absence). Policies:
+  ``least`` (scan all) or ``p2c`` (power-of-two-choices, Mitzenmacher
+  '01 — two random candidates, pick the less loaded; avoids the
+  thundering-herd-on-the-idle-worker failure mode of global-least at
+  scale).
+* **Transparent retry** — a worker's ``503`` (shed / relaunching) is
+  honored by resubmitting to a *sibling* after a bounded exponential
+  backoff; a connection failure (SIGKILLed worker) ejects the worker
+  from the pool and retries immediately. The client sees ONE answer:
+  200 if anyone could serve it within the budget, else a single 503
+  whose body merges the worst per-worker reason and whose
+  ``Retry-After`` is the soonest any worker advertised.
+* **Hedging** (opt-in) — past a deadline derived from the router's own
+  observed p99, a duplicate request (same id, same A/B arm) fires to a
+  sibling; first answer wins, the loser's connection is torn down and
+  its response is never recorded — the router's ledger counts each
+  request exactly once.
+* **Eject / readmit** — a connection-dead worker leaves the pool and a
+  probe thread re-admits it when its ``/healthz`` answers ready again
+  (the supervisor relaunching it is exactly this path).
+
+Sustained A/B (serve/rollout.py:ABTest): the router stamps each
+request's arm (``X-AB-Arm``, from the same deterministic request-id
+hash the workers use), fans ``POST /admin/ab`` out to every worker,
+and keeps its own per-arm ledger — authoritative for the verdict's
+traffic half, because hedge losers never land in it.
+
+Deliberately **jax-free and stdlib-only** (http.client/http.server +
+the obs registry): it runs inside the supervisor process, which must
+never initialize a device runtime.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import logging
+import queue as queue_mod
+import random
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.serve.metrics import percentile
+from distributedpytorch_tpu.serve.rollout import ab_arm_for
+
+logger = logging.getLogger(__name__)
+
+# 503 reasons ranked by how bad the fleet-wide story is: when EVERY
+# worker sheds, the client's single 503 carries the worst one
+_REASON_SEVERITY = ("overloaded", "relaunching", "shutdown", "unreachable")
+
+_DEPTH_RE = re.compile(
+    r"^dpt_serve_queue_depth_images(?:\{[^}]*\})?\s+([0-9.eE+-]+)\s*$",
+    re.MULTILINE,
+)
+
+
+def _worse_reason(a: Optional[str], b: Optional[str]) -> Optional[str]:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    rank = {r: i for i, r in enumerate(_REASON_SEVERITY)}
+    return a if rank.get(a, -1) >= rank.get(b, -1) else b
+
+
+class WorkerState:
+    """Router-side view of one serve worker."""
+
+    def __init__(self, name: str, host: str, port: int):
+        self.name = name
+        self.host = host
+        self.port = int(port)
+        self.healthy = True
+        self.stale = False          # healthy but not answering scrapes
+        self.inflight = 0           # router-local in-flight requests
+        self.depth = 0              # last-scraped queue depth (images)
+        self.last_scrape_t: Optional[float] = None
+        self.last_shed_reason: Optional[str] = None
+        self.last_retry_after: Optional[int] = None
+        self.ejected_t: Optional[float] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def score(self, stale_penalty: int) -> int:
+        """Placement load score: local in-flight + scraped backlog,
+        plus a penalty while the worker's numbers are stale — a wedged
+        worker must look BUSY, not idle (the scrape blind spot)."""
+        return self.inflight + self.depth + (
+            stale_penalty if self.stale else 0
+        )
+
+    def payload(self) -> dict:
+        return {
+            "address": self.address, "healthy": self.healthy,
+            "stale": self.stale, "inflight": self.inflight,
+            "depth": self.depth,
+            "last_shed_reason": self.last_shed_reason,
+        }
+
+
+class Router:
+    """See module docstring. ``workers`` is ``[(host, port), ...]``."""
+
+    def __init__(
+        self,
+        workers: Sequence[Tuple[str, int]],
+        policy: str = "p2c",
+        retry_budget: int = 3,
+        backoff_base_s: float = 0.05,
+        backoff_cap_s: float = 2.0,
+        request_timeout_s: float = 60.0,
+        hedge: bool = False,
+        hedge_factor: float = 3.0,
+        hedge_floor_ms: float = 250.0,
+        probe_interval_s: float = 1.0,
+        stale_after_s: float = 5.0,
+        stale_penalty: int = 1_000_000,
+        seed: int = 0,
+        clock=time.monotonic,
+    ):
+        if policy not in ("least", "p2c"):
+            raise ValueError(f"unknown placement policy {policy!r}")
+        self.workers = [
+            WorkerState(f"worker{i}", host, port)
+            for i, (host, port) in enumerate(workers)
+        ]
+        if not self.workers:
+            raise ValueError("a router needs at least one worker")
+        self.policy = policy
+        self.retry_budget = max(0, int(retry_budget))
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_cap_s = float(backoff_cap_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.hedge = bool(hedge)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_floor_ms = float(hedge_floor_ms)
+        self.probe_interval_s = max(0.05, float(probe_interval_s))
+        self.stale_after_s = float(stale_after_s)
+        self.stale_penalty = int(stale_penalty)
+        self.clock = clock
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # router-local latency window: the hedge deadline's p99 source
+        # and /stats' story (window-bounded like ServeMetrics)
+        self._latencies_s: collections.deque = collections.deque(maxlen=4096)
+        self.requests_ok = 0
+        self.requests_failed = 0
+        self.retries = 0
+        self.hedges_fired = 0
+        self.hedge_wins = 0
+        # sustained A/B: router-side split + per-arm ledger (the verdict
+        # half hedge losers can never pollute)
+        self.ab_active = False
+        self.ab_split = 0.5
+        self.ab_label = ""
+        self._ab_ledger: Dict[str, dict] = {}
+
+    # -- pool management -----------------------------------------------------
+    def _healthy(self) -> List[WorkerState]:
+        return [w for w in self.workers if w.healthy]
+
+    def _pick(self, exclude=()) -> Optional[WorkerState]:
+        with self._lock:
+            pool = [w for w in self.workers
+                    if w.healthy and w not in exclude]
+            if not pool:
+                return None
+            if self.policy == "p2c" and len(pool) > 2:
+                pool = self._rng.sample(pool, 2)
+            best = min(pool, key=lambda w: w.score(self.stale_penalty))
+            best.inflight += 1
+            return best
+
+    def _release(self, worker: WorkerState) -> None:
+        with self._lock:
+            worker.inflight = max(0, worker.inflight - 1)
+
+    def _eject(self, worker: WorkerState) -> None:
+        with self._lock:
+            if not worker.healthy:
+                return
+            worker.healthy = False
+            worker.ejected_t = self.clock()
+            worker.last_shed_reason = "unreachable"
+        obsm.ROUTER_WORKER_EVENTS.labels(event="eject").inc()
+        obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
+        flight.record("router_worker", event="eject", worker=worker.address)
+        logger.warning("router: ejected %s (connection failure)",
+                       worker.address)
+
+    def _readmit(self, worker: WorkerState) -> None:
+        with self._lock:
+            if worker.healthy:
+                return
+            worker.healthy = True
+            worker.stale = False
+            worker.ejected_t = None
+            worker.last_shed_reason = None
+        obsm.ROUTER_WORKER_EVENTS.labels(event="readmit").inc()
+        obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
+        flight.record("router_worker", event="readmit",
+                      worker=worker.address)
+        logger.info("router: readmitted %s (/healthz ready)",
+                    worker.address)
+
+    def ingest_fleet_metrics(self, expositions: Dict[str, str]) -> None:
+        """Feed of the fleet metrics scraper (dist/elastic.py): parse
+        each answering worker's queue depth out of its exposition text;
+        a healthy worker MISSING from the sweep goes stale — it scores
+        as pressure until it answers again."""
+        now = self.clock()
+        for i, worker in enumerate(self.workers):
+            text = expositions.get(str(i))
+            if text is None:
+                if worker.healthy and not worker.stale:
+                    worker.stale = True
+                    obsm.ROUTER_WORKER_EVENTS.labels(event="stale").inc()
+                continue
+            m = None
+            for m in _DEPTH_RE.finditer(text):
+                pass  # last match (merged expositions repeat families)
+            if m is not None:
+                worker.depth = int(float(m.group(1)))
+            worker.stale = False
+            worker.last_scrape_t = now
+
+    # -- transport -----------------------------------------------------------
+    def _send(self, worker: WorkerState, method: str, path: str,
+              body: Optional[bytes] = None, headers: Optional[dict] = None,
+              timeout: Optional[float] = None, conn_box: Optional[list] = None,
+              ):
+        """One HTTP exchange; returns ``(code, headers, body)`` or None
+        on a connection-level failure. ``conn_box`` (a list) receives
+        the live connection so a hedging loser can be torn down from
+        the winner's thread."""
+        conn = http.client.HTTPConnection(
+            worker.host, worker.port,
+            timeout=timeout if timeout is not None else self.request_timeout_s,
+        )
+        if conn_box is not None:
+            conn_box.append(conn)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+            return resp.status, dict(resp.getheaders()), data
+        except Exception:  # noqa: BLE001 — any transport failure is one
+            # verdict: this worker is unreachable right now
+            return None
+        finally:
+            conn.close()
+
+    # -- the proxy core ------------------------------------------------------
+    def proxy_predict(self, body: bytes, request_id: str,
+                      headers: Optional[dict] = None,
+                      ) -> Tuple[int, dict, bytes]:
+        """Route one ``/predict`` through the fleet (see module
+        docstring for the retry/hedge contract). Returns the single
+        client-visible ``(code, headers, body)``."""
+        t0 = self.clock()
+        fwd_headers = dict(headers or {})
+        fwd_headers["X-Request-Id"] = request_id
+        arm = ""
+        if self.ab_active:
+            arm = fwd_headers.get("X-AB-Arm") or ab_arm_for(
+                request_id, self.ab_split)
+            fwd_headers["X-AB-Arm"] = arm
+
+        tried: set = set()
+        sheds: Dict[str, Tuple[str, Optional[int]]] = {}
+        last_error: Optional[Tuple[int, dict, bytes]] = None
+        attempts = 0
+        backoff = self.backoff_base_s
+        while attempts <= self.retry_budget:
+            worker = self._pick(exclude=tried)
+            if worker is None:
+                if tried and self._healthy():
+                    # every healthy worker shed once: later attempts may
+                    # retry them (their Retry-After may have elapsed)
+                    tried = set()
+                    continue
+                break  # nobody healthy at all
+            tried.add(worker)
+            attempts += 1
+            try:
+                result = self._send_maybe_hedged(
+                    worker, body, fwd_headers, tried)
+            finally:
+                self._release(worker)
+            if result is None:
+                self._eject(worker)
+                if attempts <= self.retry_budget:
+                    obsm.ROUTER_RETRIES.labels(reason="connection").inc()
+                    self.retries += 1
+                continue  # immediate sibling — no backoff for a corpse
+            code, rhdrs, rbody = result
+            if code == 503:
+                reason, retry_after = self._shed_info(rhdrs, rbody)
+                worker.last_shed_reason = reason
+                worker.last_retry_after = retry_after
+                sheds[worker.address] = (reason, retry_after)
+                if attempts <= self.retry_budget:
+                    obsm.ROUTER_RETRIES.labels(reason="shed").inc()
+                    self.retries += 1
+                    self._stop.wait(min(backoff, self.backoff_cap_s))
+                    backoff = min(backoff * 2.0, self.backoff_cap_s)
+                continue
+            if code >= 500 and attempts <= self.retry_budget:
+                # non-shed worker failure (e.g. an in-flight future died
+                # with a relaunching core): /predict is pure inference,
+                # so resubmitting to a sibling is safe — keep the answer
+                # around in case every avenue fails the same way
+                last_error = (code, rhdrs, rbody)
+                obsm.ROUTER_RETRIES.labels(reason="error").inc()
+                self.retries += 1
+                self._stop.wait(min(backoff, self.backoff_cap_s))
+                backoff = min(backoff * 2.0, self.backoff_cap_s)
+                continue
+            # an answer (200/4xx): the client's answer
+            self._finish(code, arm, self.clock() - t0)
+            out = {"X-Router-Attempts": str(attempts),
+                   "X-Router-Worker": worker.address}
+            for key in ("X-Request-Id", "X-Serve-Latency-Ms",
+                        "Content-Type"):
+                if key in rhdrs:
+                    out[key] = rhdrs[key]
+            return code, out, rbody
+
+        # honest degradation. A real (non-shed) worker error with no
+        # shedding anywhere is returned as-is — inventing a 503 would
+        # misreport a failure as overload.
+        if last_error is not None and not sheds:
+            code, rhdrs, rbody = last_error
+            self._finish(code, arm, self.clock() - t0)
+            out = {"X-Router-Attempts": str(attempts)}
+            for key in ("X-Request-Id", "Content-Type"):
+                if key in rhdrs:
+                    out[key] = rhdrs[key]
+            return code, out, rbody
+        # every avenue exhausted → ONE 503 whose body names each
+        # worker's last reason and leads with the worst
+        worst = None
+        soonest: Optional[int] = None
+        for reason, retry_after in sheds.values():
+            worst = _worse_reason(worst, reason)
+            if retry_after is not None:
+                soonest = (retry_after if soonest is None
+                           else min(soonest, retry_after))
+        if worst is None:
+            worst = "unreachable"
+        self._finish(503, arm, self.clock() - t0)
+        payload = json.dumps({
+            "status": "rejected", "reason": worst,
+            "request_id": request_id, "attempts": attempts,
+            "workers": {addr: reason for addr, (reason, _) in sheds.items()},
+        }).encode()
+        out = {"Content-Type": "application/json",
+               "X-Request-Id": request_id,
+               "X-Router-Attempts": str(attempts)}
+        if soonest is not None:
+            out["Retry-After"] = str(int(soonest))
+        return 503, out, payload
+
+    def _send_maybe_hedged(self, primary: WorkerState, body: bytes,
+                           headers: dict, tried: set):
+        """The primary exchange, with an optional single hedge to a
+        sibling past the p99-derived deadline. Exactly one result is
+        returned and recorded; the loser's connection is closed."""
+        if not self.hedge:
+            return self._send(primary, "POST", "/predict", body, headers)
+        results: "queue_mod.Queue" = queue_mod.Queue()
+        boxes: Dict[str, list] = {"primary": [], "hedge": []}
+
+        def call(worker: WorkerState, tag: str) -> None:
+            results.put((tag, self._send(
+                worker, "POST", "/predict", body, headers,
+                conn_box=boxes[tag])))
+
+        threading.Thread(target=call, args=(primary, "primary"),
+                         name="dpt-router-req", daemon=True).start()
+        try:
+            tag, result = results.get(timeout=self._hedge_delay_s())
+            return result
+        except queue_mod.Empty:
+            pass
+        sibling = self._pick(exclude=tried | {primary})
+        if sibling is None:  # nobody to hedge to — wait the primary out
+            tag, result = results.get()
+            return result
+        self.hedges_fired += 1
+        try:
+            threading.Thread(target=call, args=(sibling, "hedge"),
+                             name="dpt-router-hedge", daemon=True).start()
+            tag, result = results.get()  # first answer wins
+        finally:
+            self._release(sibling)
+        loser = "hedge" if tag == "primary" else "primary"
+        for conn in boxes[loser]:
+            try:  # tear the loser down: its response is never read,
+                # never recorded — cancelled, not double-counted
+                conn.close()
+            except Exception:  # noqa: BLE001
+                pass
+        obsm.ROUTER_HEDGES.labels(winner=tag).inc()
+        if tag == "hedge":
+            self.hedge_wins += 1
+        flight.record("router_hedge", winner=tag,
+                      primary=primary.address, sibling=sibling.address)
+        return result
+
+    def _hedge_delay_s(self) -> float:
+        with self._lock:
+            lat = list(self._latencies_s)
+        p99_ms = percentile(lat, 99) * 1e3 if lat else 0.0
+        return max(self.hedge_factor * p99_ms, self.hedge_floor_ms) / 1e3
+
+    @staticmethod
+    def _shed_info(rhdrs: dict, rbody: bytes
+                   ) -> Tuple[str, Optional[int]]:
+        reason = "overloaded"
+        try:
+            reason = json.loads(rbody).get("reason", reason)
+        except Exception:  # noqa: BLE001
+            pass
+        retry_after = None
+        ra = rhdrs.get("Retry-After")
+        if ra is not None:
+            try:
+                retry_after = int(float(ra))
+            except ValueError:
+                pass
+        return reason, retry_after
+
+    def _finish(self, code: int, arm: str, latency_s: float) -> None:
+        with self._lock:
+            if code == 200:
+                self.requests_ok += 1
+                self._latencies_s.append(latency_s)
+            else:
+                self.requests_failed += 1
+            if arm:
+                led = self._ab_ledger.setdefault(arm, {
+                    "requests_ok": 0, "requests_failed": 0,
+                    "latencies_s": collections.deque(maxlen=4096),
+                })
+                if code == 200:
+                    led["requests_ok"] += 1
+                    led["latencies_s"].append(latency_s)
+                else:
+                    led["requests_failed"] += 1
+        obsm.ROUTER_REQUESTS.labels(code=str(code)).inc()
+
+    # -- sustained A/B fan-out ----------------------------------------------
+    def admin_ab(self, spec: dict) -> Tuple[int, dict]:
+        """``POST /admin/ab`` front: fan the action out to every
+        healthy worker and merge. ``spec`` carries ``action``
+        (start/verdict/stop) plus start's ``checkpoint``/``split``/
+        ``label`` or stop's ``winner``."""
+        action = spec.get("action")
+        if action not in ("start", "verdict", "stop"):
+            return 400, {"error": "action must be start|verdict|stop"}
+        per_worker: Dict[str, dict] = {}
+        codes: List[int] = []
+        for worker in self._healthy():
+            result = self._send(worker, "POST", "/admin/ab",
+                                json.dumps(spec).encode(),
+                                {"Content-Type": "application/json"},
+                                timeout=30.0)
+            if result is None:
+                per_worker[worker.address] = {"error": "unreachable"}
+                codes.append(503)
+                continue
+            code, _, rbody = result
+            codes.append(code)
+            try:
+                per_worker[worker.address] = json.loads(rbody)
+            except Exception:  # noqa: BLE001
+                per_worker[worker.address] = {"error": rbody[:200].decode(
+                    "utf-8", "replace")}
+        ok = bool(codes) and all(c < 400 for c in codes)
+        if action == "start" and ok:
+            self.ab_active = True
+            self.ab_split = float(spec.get("split", 0.5))
+            self.ab_label = str(spec.get("label", ""))
+            with self._lock:
+                self._ab_ledger = {}
+        elif action == "stop":
+            self.ab_active = False
+        body = {
+            "action": action, "ok": ok,
+            "router": self.ab_status(),
+            "workers": per_worker,
+        }
+        return (200 if ok else 502), body
+
+    def ab_status(self) -> dict:
+        with self._lock:
+            ledger = {
+                arm: (dict(led), list(led["latencies_s"]))
+                for arm, led in self._ab_ledger.items()
+            }
+        arms = {}
+        for arm, (led, lat) in sorted(ledger.items()):
+            arms[arm] = {
+                "requests_ok": led["requests_ok"],
+                "requests_failed": led["requests_failed"],
+                "p50_ms": round(percentile(lat, 50) * 1e3, 3) if lat else None,
+                "p99_ms": round(percentile(lat, 99) * 1e3, 3) if lat else None,
+            }
+        return {"active": self.ab_active, "split": self.ab_split,
+                "label": self.ab_label, "arms": arms}
+
+    # -- health probe thread -------------------------------------------------
+    def probe_once(self) -> None:
+        """One sweep: re-probe ejected workers' ``/healthz``; with no
+        external metrics feed, scrape healthy workers' ``/stats`` for
+        depth (and mark the silent ones stale)."""
+        now = self.clock()
+        for worker in self.workers:
+            if not worker.healthy:
+                result = self._send(worker, "GET", "/healthz",
+                                    timeout=2.0)
+                if result is not None and result[0] == 200:
+                    self._readmit(worker)
+                continue
+            result = self._send(worker, "GET", "/stats", timeout=2.0)
+            if result is None or result[0] != 200:
+                if (worker.last_scrape_t is None
+                        or now - worker.last_scrape_t > self.stale_after_s):
+                    if not worker.stale:
+                        worker.stale = True
+                        obsm.ROUTER_WORKER_EVENTS.labels(
+                            event="stale").inc()
+                continue
+            try:
+                stats = json.loads(result[2])
+                worker.depth = int(stats.get("queue_depth_images", 0))
+            except Exception:  # noqa: BLE001
+                pass
+            worker.stale = False
+            worker.last_scrape_t = now
+
+    def _probe_loop(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the probe must outlive
+                # one bad sweep
+                logger.exception("router: probe sweep failed")
+
+    def start(self) -> "Router":
+        obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
+        self._thread = threading.Thread(
+            target=self._probe_loop, name="dpt-router-probe", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def stats(self) -> dict:
+        with self._lock:
+            lat = list(self._latencies_s)
+        return {
+            "policy": self.policy,
+            "workers": [w.payload() for w in self.workers],
+            "healthy_workers": len(self._healthy()),
+            "requests_ok": self.requests_ok,
+            "requests_failed": self.requests_failed,
+            "retries": self.retries,
+            "hedges_fired": self.hedges_fired,
+            "hedge_wins": self.hedge_wins,
+            "p50_ms": round(percentile(lat, 50) * 1e3, 3) if lat else None,
+            "p99_ms": round(percentile(lat, 99) * 1e3, 3) if lat else None,
+            "ab": self.ab_status(),
+        }
+
+
+def make_router_http(router: Router, host: str = "127.0.0.1",
+                     port: int = 0):
+    """Wrap a :class:`Router` in a ThreadingHTTPServer (port 0 =
+    ephemeral) — the ONE address clients talk to. Routes: ``POST
+    /predict`` (proxied with retry/hedge), ``POST /admin/ab`` (fleet
+    fan-out), ``GET /healthz`` (200 while >= 1 worker is routable),
+    ``GET /stats``, ``GET /metrics``."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from distributedpytorch_tpu.obs.http import metrics_response
+    from distributedpytorch_tpu.obs.reqtrace import (
+        new_request_id,
+        request_id_from_headers,
+    )
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, obj: dict,
+                  headers: Optional[dict] = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for key, value in (headers or {}).items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 — http.server's contract
+            if self.path == "/healthz":
+                healthy = len(router._healthy())
+                self._json(200 if healthy else 503, {
+                    "ready": healthy > 0,
+                    "healthy_workers": healthy,
+                    "workers": [w.payload() for w in router.workers],
+                })
+            elif self.path == "/livez":
+                self._json(200, {"status": "alive"})
+            elif self.path == "/stats":
+                self._json(200, router.stats())
+            elif self.path == "/metrics":
+                body, ctype = metrics_response()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):  # noqa: N802
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            if self.path == "/admin/ab":
+                try:
+                    spec = json.loads(body or b"{}")
+                except ValueError:
+                    self._json(400, {"error": "body must be JSON"})
+                    return
+                code, payload = router.admin_ab(spec)
+                self._json(code, payload)
+                return
+            if self.path != "/predict":
+                self._json(404, {"error": f"no route {self.path}"})
+                return
+            rid = (request_id_from_headers(self.headers)
+                   or new_request_id())
+            fwd = {}
+            for key in ("Content-Type", "X-AB-Arm", "traceparent"):
+                if key in self.headers:
+                    fwd[key] = self.headers[key]
+            code, rhdrs, rbody = router.proxy_predict(
+                body, request_id=rid, headers=fwd)
+            self.send_response(code)
+            rhdrs.setdefault("X-Request-Id", rid)
+            rhdrs["Content-Length"] = str(len(rbody))
+            for key, value in rhdrs.items():
+                self.send_header(key, value)
+            self.end_headers()
+            self.wfile.write(rbody)
+
+        def log_message(self, fmt, *fmt_args):  # route through logging
+            logger.debug("router-http: " + fmt, *fmt_args)
+
+    return ThreadingHTTPServer((host, port), Handler)

@@ -1,0 +1,295 @@
+"""Planner-actuated replica autoscaling: ACT on ``dpt_serve_replica_hint``.
+
+PR 13 left autoscaling split in two honest halves: serve/autoscale.py
+*recommends* (queue-depth/shed hysteresis → the
+``dpt_serve_replica_hint`` gauge) and a human resizes. This module is
+the missing actuator — it grows and shrinks the LIVE replica group
+through ``Server.resize_replicas`` (AOT-store-backed executables, no
+worker restart, no drain) whenever the hint diverges from reality.
+
+Two disciplines keep the control loop boring:
+
+* **The plan-serve grid is the control law.** Every decision is cited
+  against the ``dpt_serve_plan`` artifact (analysis/serve_planner.py):
+  the scaler matches the observed arrival rate to the nearest simulated
+  poisson scenario and logs the grid **point key** its new replica
+  count corresponds to — so a 2→4 scale-up reads
+  ``plan_point=poisson:8rps/b1,4,8/slo50/r4/eager/capauto`` in the
+  flight ring and ``/stats``, and an operator can open the plan and see
+  the predicted p99/shed that decision was buying. No plan → decisions
+  still happen (the hint alone), cited as ``plan_point=None``.
+* **No flapping.** The scaler refuses to act more often than the
+  hint's own hysteresis (``cooldown_windows`` — default the max of the
+  hint's up/down window counts) and holds entirely while replica
+  groups are pinned by a sustained A/B or a mid-flight rollout
+  (mixed weight versions): resizing would tear an arm boundary.
+
+Actuations land in ``dpt_serve_scale_events_total`` (by direction),
+``dpt_serve_replicas``, and the flight ring; after every resize the
+hint's ``depth_high`` pressure line is re-anchored to the new capacity
+so the NEXT recommendation judges the fleet that exists, not the one
+that did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, List, Optional
+
+from distributedpytorch_tpu.obs import defs as obsm
+from distributedpytorch_tpu.obs import flight
+
+logger = logging.getLogger(__name__)
+
+DIR_UP = "up"
+DIR_DOWN = "down"
+DIR_HOLD = "hold"
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    """One control-loop verdict: what to do, and which plan point says
+    it's the right thing to do."""
+
+    direction: str              # up | down | hold
+    current: int
+    target: int
+    reason: str
+    plan_point: Optional[str] = None    # grid point key this executes
+    plan_replicas: Optional[int] = None  # the plan's own recommendation
+    rate_rps: Optional[float] = None    # observed rate matched to the plan
+
+    def payload(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ReplicaScaler:
+    """The hint's actuator (see module docstring).
+
+    ``plan`` is a loaded ``dpt_serve_plan`` payload dict, a path to
+    one, or None. ``step()`` is the whole control loop iteration —
+    read the hint, decide, act — and is what both the background
+    thread and the deterministic tests drive.
+    """
+
+    def __init__(
+        self,
+        server,
+        hint,
+        plan=None,
+        min_replicas: int = 1,
+        max_replicas: Optional[int] = None,
+        cooldown_windows: Optional[int] = None,
+        interval_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.server = server
+        self.hint = hint
+        if isinstance(plan, str):
+            from distributedpytorch_tpu.analysis.serve_planner import (
+                load_serve_plan,
+            )
+            plan = load_serve_plan(plan)
+        self.plan = plan
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = (
+            int(max_replicas) if max_replicas is not None else None
+        )
+        self.cooldown_windows = int(
+            cooldown_windows if cooldown_windows is not None
+            else max(int(hint.up_windows), int(hint.down_windows))
+        )
+        self.interval_s = (
+            float(interval_s) if interval_s is not None
+            else float(hint.interval_s)
+        )
+        self.clock = clock
+        # start past cooldown: the FIRST divergence may act immediately
+        self.windows_since_action = self.cooldown_windows
+        self.decisions: List[dict] = []  # bounded ledger (status())
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # arrival-rate observation state (thread mode)
+        self._last_requests = None
+        self._last_t = None
+
+    # -- the control law -----------------------------------------------------
+    def decide(self, recommendation: Optional[int],
+               observed_rate_rps: Optional[float] = None) -> ScaleDecision:
+        """Pure verdict: no actuation, no counters — tests drive this
+        directly with a fake hint value and an explicit rate."""
+        current = self.server.engine.num_replicas
+        if recommendation is None:
+            return ScaleDecision(DIR_HOLD, current, current,
+                                 "no hint observed yet")
+        abtest = getattr(self.server, "abtest", None)
+        if (abtest is not None and abtest.active) or (
+                getattr(self.server, "ab_arms", None) is not None):
+            return ScaleDecision(
+                DIR_HOLD, current, current,
+                "replica groups pinned by a sustained A/B")
+        if self.server.engine.versions_mixed:
+            return ScaleDecision(
+                DIR_HOLD, current, current,
+                "weight versions mixed (rollout in flight)")
+        cap = self.max_replicas
+        if cap is None:
+            import jax
+            cap = len(jax.devices())
+        target = min(max(int(recommendation), self.min_replicas), cap)
+        plan_point, plan_replicas = self._plan_point(
+            target, observed_rate_rps)
+        if target == current:
+            return ScaleDecision(DIR_HOLD, current, current,
+                                 "hint matches live replica count",
+                                 plan_point, plan_replicas,
+                                 observed_rate_rps)
+        if self.windows_since_action < self.cooldown_windows:
+            return ScaleDecision(
+                DIR_HOLD, current, current,
+                f"cooldown ({self.windows_since_action}/"
+                f"{self.cooldown_windows} windows since last action)",
+                plan_point, plan_replicas, observed_rate_rps)
+        direction = DIR_UP if target > current else DIR_DOWN
+        return ScaleDecision(
+            direction, current, target,
+            f"hint {recommendation} vs live {current}",
+            plan_point, plan_replicas, observed_rate_rps)
+
+    def _plan_point(self, target: int,
+                    rate_rps: Optional[float]):
+        """Cite the plan: the grid point key at the base knobs whose
+        (scenario, replicas) matches what this decision executes, plus
+        the scenario's own recommended replica count. The scenario is
+        the nearest simulated poisson rate at or above the observed
+        arrival rate (the conservative match: plan for at least the
+        load you see); with no observed rate, the scenario whose
+        recommendation equals the target."""
+        plan = self.plan
+        if not plan:
+            return None, None
+        scenarios = [s for s in plan.get("scenarios", [])
+                     if s.get("kind") == "poisson"
+                     and s.get("rate_rps") is not None]
+        recs = plan.get("recommendations", [])
+        label = None
+        if scenarios and rate_rps is not None:
+            geq = [s for s in scenarios
+                   if float(s["rate_rps"]) >= float(rate_rps) - 1e-9]
+            pick = (min(geq, key=lambda s: float(s["rate_rps"])) if geq
+                    else max(scenarios, key=lambda s: float(s["rate_rps"])))
+            label = pick["label"]
+        elif recs:
+            for rec in recs:
+                if rec.get("replicas") == target:
+                    label = rec["scenario"]
+                    break
+            if label is None:
+                label = recs[0]["scenario"]
+        if label is None:
+            return None, None
+        plan_replicas = next(
+            (rec.get("replicas") for rec in recs
+             if rec.get("scenario") == label), None)
+        grid = plan.get("grid", {})
+        base_ladder = (grid.get("bucket_ladders") or [[]])[0]
+        base_eager = (grid.get("eager") or [True])[0]
+        base_cap = (grid.get("queue_caps") or [None])[0]
+        for p in plan.get("points", []):
+            if (p.get("scenario") == label
+                    and p.get("replicas") == target
+                    and p.get("bucket_sizes") == base_ladder
+                    and p.get("eager") == base_eager
+                    and p.get("queue_cap_images") == base_cap):
+                return p.get("key"), plan_replicas
+        return None, plan_replicas
+
+    # -- actuation -----------------------------------------------------------
+    def apply(self, decision: ScaleDecision) -> ScaleDecision:
+        """Execute a non-hold decision through the server's live
+        resizer; stamps the ledger/flight/metric trail either way."""
+        achieved = decision.current
+        if decision.direction != DIR_HOLD:
+            achieved = self.server.resize_replicas(decision.target)
+            if achieved != decision.current:
+                self.windows_since_action = 0
+                if decision.direction == DIR_UP:
+                    self.scale_ups += 1
+                else:
+                    self.scale_downs += 1
+                # re-anchor the hint's pressure line to the NEW capacity
+                # (it was frozen at init against the old replica count)
+                self.hint.depth_high = (
+                    self.server.engine.planner.max_size * achieved
+                )
+                obsm.SERVE_SCALE_EVENTS.labels(
+                    direction=decision.direction).inc()
+                logger.info(
+                    "scaler: %s %d -> %d (%s) plan_point=%s",
+                    decision.direction, decision.current, achieved,
+                    decision.reason, decision.plan_point,
+                )
+            entry = {**decision.payload(), "achieved": achieved}
+            self.decisions.append(entry)
+            del self.decisions[:-50]
+            flight.record("serve_scale", **{
+                k: v for k, v in entry.items() if v is not None})
+        return dataclasses.replace(decision, target=achieved)
+
+    def step(self, observed_rate_rps: Optional[float] = None
+             ) -> ScaleDecision:
+        """One control-loop window: age the cooldown, read the hint's
+        latest recommendation, decide, act."""
+        self.windows_since_action += 1
+        decision = self.decide(self.hint.recommendation, observed_rate_rps)
+        return self.apply(decision)
+
+    # -- background thread (worker mode) -------------------------------------
+    def _observed_rate(self) -> Optional[float]:
+        snap = self.server.metrics.snapshot()
+        now = self.clock()
+        total = snap["requests_ok"] + snap["requests_failed"] + snap.get(
+            "rejected_total", 0)
+        rate = None
+        if self._last_requests is not None and now > self._last_t:
+            rate = (total - self._last_requests) / (now - self._last_t)
+        self._last_requests, self._last_t = total, now
+        return rate
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step(observed_rate_rps=self._observed_rate())
+            except Exception:  # noqa: BLE001 — the control loop must
+                # outlive one bad window; the failure is in the log
+                logger.exception("scaler: step failed")
+
+    def start(self) -> "ReplicaScaler":
+        self._thread = threading.Thread(
+            target=self._run, name="dpt-serve-scaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def status(self) -> dict:
+        return {
+            "replicas": self.server.engine.num_replicas,
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "cooldown_windows": self.cooldown_windows,
+            "windows_since_action": self.windows_since_action,
+            "scale_ups": self.scale_ups,
+            "scale_downs": self.scale_downs,
+            "plan": bool(self.plan),
+            "decisions": self.decisions[-10:],
+        }

@@ -173,7 +173,7 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
                 req.trace.mark("resolved", resolve_t)
             server.metrics.record_request(
                 req.size, req.enqueue_t, dispatch_t, done_t,
-                request_id=req.request_id,
+                request_id=req.request_id, arm=req.arm,
             )
             req.future.set_result(ServeResponse(
                 key=req.key, status=STATUS_OK, masks=masks,
@@ -194,7 +194,7 @@ def pull(server: "Server", replica: Replica, out, bucket: int,
         logger.exception("completion drain failed for bucket %d", bucket)
         for req in reqs:  # the requests, never hang their futures
             if not req.future.done():
-                server.metrics.record_failure()
+                server.metrics.record_failure(arm=req.arm)
                 req.future.set_result(ServeResponse(
                     key=req.key, status=STATUS_ERROR, reason=str(exc),
                     request_id=req.request_id,
@@ -274,12 +274,16 @@ class Server:
         # H2D and compute overlap without the device queue becoming an
         # unbounded latency buffer.
         self._free: queue_mod.Queue = queue_mod.Queue()
-        for _slot in range(max(1, int(inflight_per_replica))):
+        self.inflight_per_replica = max(1, int(inflight_per_replica))
+        for _slot in range(self.inflight_per_replica):
             for replica in engine.replicas:
                 self._free.put(replica)
         # all-slots-free is the drain test for "nothing in flight":
         # slots return at completion, AFTER futures resolve
         self._total_slots = self._free.qsize()
+        # serializes resize_replicas against itself (the scaler thread
+        # and an /admin caller must not race the slot-pool surgery)
+        self._resize_lock = threading.Lock()
         if completion_workers is None:
             # every in-flight slot must be drainable concurrently, or the
             # drain pool (not the devices) becomes the throughput ceiling
@@ -299,6 +303,13 @@ class Server:
         self._completed = 0  # requests served; heartbeat step counter
         self.heartbeat = None  # dist/health.Heartbeat when supervised
         self.rollout = None  # serve/rollout.RolloutManager when attached
+        self.abtest = None  # serve/rollout.ABTest when attached
+        self.scaler = None  # serve/scaler.ReplicaScaler when attached
+        # sustained-A/B replica-group map ({"a": indices, "b": indices})
+        # set by ABTest.start / cleared by ABTest.stop; None = no A/B.
+        # _claim_replica filters the slot pool through it so an armed
+        # batch only ever lands on its own arm's replicas.
+        self.ab_arms = None
         self.config = None  # set by from_config; /healthz fingerprint
         # serve/sim.ArrivalRecorder when --record-arrivals is set: one
         # bounded JSONL line per ingress (wall-time, rows, bucket) —
@@ -416,7 +427,7 @@ class Server:
         self._state = STATE_STOPPED
         # fleet components attached by serve/cli.attach_fleet (watcher
         # and autoscale are plain attrs — absent on bare servers)
-        for attr in ("watcher", "autoscale", "rollout"):
+        for attr in ("watcher", "scaler", "autoscale", "abtest", "rollout"):
             component = getattr(self, attr, None)
             if component is not None:
                 component.stop()
@@ -438,7 +449,7 @@ class Server:
 
     # -- ingress -------------------------------------------------------------
     def submit(self, images, key: str = "",
-               request_id: Optional[str] = None,
+               request_id: Optional[str] = None, arm: str = "",
                ) -> "concurrent.futures.Future":
         """Admit one request. ``images``: a single ``(H, W, C)`` row, a
         ``(k, H, W, C)`` stack, a list of rows, or a list of path
@@ -447,10 +458,19 @@ class Server:
         and shutdown included. ``request_id`` is the caller-supplied
         trace id (W3C ``traceparent`` at the HTTP front); None assigns
         one — every response carries it, and every 503 path stamps it
-        into the flight ring with its reason."""
+        into the flight ring with its reason. ``arm`` pins the request
+        to a sustained-A/B replica group (the router's ``X-AB-Arm``
+        header); empty with an A/B running, the server derives it from
+        the request id so direct clients split deterministically too."""
         future: concurrent.futures.Future = concurrent.futures.Future()
         trace = self.tracer.begin(request_id=request_id)
         rid = trace.request_id if trace is not None else (request_id or "")
+        abtest = self.abtest
+        if abtest is not None and abtest.active:
+            if not arm or arm not in (self.ab_arms or ()):
+                arm = abtest.arm_for(rid)
+        else:
+            arm = ""
         recorder = self.arrival_recorder
         state = self._state
         if state != STATE_SERVING:
@@ -466,7 +486,7 @@ class Server:
                       else REJECT_SHUTDOWN)
             status = (STATUS_REJECTED if state == STATE_RELAUNCHING
                       else STATUS_SHUTDOWN)
-            self.metrics.record_rejection(reason)
+            self.metrics.record_rejection(reason, arm=arm)
             self.tracer.reject(trace, reason, request_id=rid, state=state)
             future.set_result(ServeResponse(
                 key=key, status=status, reason=reason, request_id=rid,
@@ -478,7 +498,7 @@ class Server:
         except Exception as exc:  # noqa: BLE001 — bad input is a response
             if recorder is not None:
                 recorder.record(time.time(), self._estimate_rows(images))
-            self.metrics.record_failure()
+            self.metrics.record_failure(arm=arm)
             self.tracer.complete(trace, STATUS_ERROR)
             future.set_result(ServeResponse(
                 key=key, status=STATUS_ERROR, reason=str(exc),
@@ -516,7 +536,8 @@ class Server:
                 return future
         req = ServeRequest(images=rows, future=future, key=key,
                            request_id=rid, trace=trace,
-                           cache_key=cache_key, cache_version=cache_version)
+                           cache_key=cache_key, cache_version=cache_version,
+                           arm=arm)
         reason = self.queue.submit(req)
         if reason is not None:
             if reason == REJECT_SHUTDOWN and self._state != STATE_STOPPED:
@@ -524,7 +545,7 @@ class Server:
                 # queue admit: this instance is RELAUNCHING, not going
                 # away — don't send the client elsewhere over a blip
                 reason = REJECT_RELAUNCHING
-            self.metrics.record_rejection(reason)
+            self.metrics.record_rejection(reason, arm=arm)
             self.tracer.reject(trace, reason, request_id=rid,
                                rows=len(rows), cache_bypassed=cache_bypassed)
             # a stopping server answers "shutdown" (retry elsewhere),
@@ -609,7 +630,9 @@ class Server:
         # placement-transition marker (ring slot only; dptlint's
         # obs-hot-path/serve-hot-path rules keep anything blocking out)
         flight.record("serve_place", bucket=bucket, reqs=len(reqs))
-        replica = self._claim_replica()
+        # groups are arm-pure by construction (the queue flushes only
+        # head same-arm runs), so the first request names the group's arm
+        replica = self._claim_replica(arm=reqs[0].arm)
         if replica is None:  # stopping — these were already popped from
             # the queue, so queue.stop() will never see them: resolve
             # here or their futures hang forever
@@ -649,16 +672,99 @@ class Server:
                     self.tracer.complete(req.trace, STATUS_ERROR)
             return _PLACE_FAILED
 
-    def _claim_replica(self) -> Optional[Replica]:
+    def _claim_replica(self, arm: str = "") -> Optional[Replica]:
         # reads the CURRENT incarnation's stop event from self: the
         # supervisor only replaces it after this incarnation's stream is
         # fully drained, so a worker parked here always sees its own
         while not (self._gen_stop.is_set() or self._stop.is_set()):
             try:
-                return self._free.get(timeout=0.1)
+                replica = self._free.get(timeout=0.1)
             except queue_mod.Empty:
                 continue
+            arms = self.ab_arms
+            if arm and arms is not None and arm in arms:
+                if replica.index not in arms[arm]:
+                    # wrong arm's slot: return it and keep waiting for
+                    # one of ours — the put wakes any sibling claimer,
+                    # and the pause keeps a fully-busy arm from spinning
+                    # this thread hot against its own put-backs
+                    self._free.put(replica)
+                    time.sleep(0.002)
+                    continue
+            return replica
         return None
+
+    # -- live replica-group scaling (serve/scaler.py's actuator) -------------
+    def resize_replicas(self, target: int, timeout: float = 30.0) -> int:
+        """Grow or shrink the LIVE replica group to ``target`` without a
+        restart — the autoscaler's actuator, also callable directly.
+
+        Grow: ``engine.add_replica()`` per step (an AOT-store hit makes
+        each one a load, not a compile) and seed its in-flight slots
+        into the pool — the very next flush can land on it. Shrink:
+        claim the victim replica's slots OUT of the pool first (waiting
+        for in-flight dispatches to drain them back), so the replica is
+        provably idle before ``engine.retire_replica()`` drops it.
+        Returns the replica count actually reached; a shrink that
+        cannot drain the victim within ``timeout`` puts everything back
+        and stops there — serving correctness over scale-down punctuality.
+        Refuses (no-op) while replica groups serve mixed weight
+        versions: resizing would cut across a canary or A/B group."""
+        with self._resize_lock:
+            target = max(1, int(target))
+            if target != self.engine.num_replicas and (
+                    self.engine.versions_mixed or self.ab_arms is not None):
+                logger.warning(
+                    "resize to %d refused: replica groups are pinned "
+                    "(rollout canary or A/B in flight)", target,
+                )
+                return self.engine.num_replicas
+            while self.engine.num_replicas < target:
+                replica = self.engine.add_replica()
+                # the completion pool was sized for the construction-time
+                # replica count; raise its ceiling so the new slots stay
+                # drainable concurrently (threads spawn lazily)
+                self._completion._max_workers = max(
+                    self._completion._max_workers,
+                    (self.engine.num_replicas * self.inflight_per_replica),
+                )
+                for _slot in range(self.inflight_per_replica):
+                    self._free.put(replica)
+                self._total_slots += self.inflight_per_replica
+                self.queue.kick()
+            while self.engine.num_replicas > target:
+                victim = self.engine.replicas[-1]
+                held = 0
+                deadline = time.monotonic() + timeout
+                while held < self.inflight_per_replica:
+                    if time.monotonic() > deadline or self._stop.is_set():
+                        for _ in range(held):
+                            self._free.put(victim)
+                        logger.warning(
+                            "shrink to %d aborted: replica %d still has "
+                            "in-flight work after %.0fs",
+                            target, victim.index, timeout,
+                        )
+                        self.queue.kick()
+                        return self.engine.num_replicas
+                    try:
+                        replica = self._free.get(timeout=0.1)
+                    except queue_mod.Empty:
+                        continue
+                    if replica is victim:
+                        held += 1  # slot leaves the pool for good
+                    else:
+                        # hand non-victim slots straight back — serving
+                        # continues at full strength during the drain;
+                        # the pause keeps this from spinning against its
+                        # own put-back
+                        self._free.put(replica)
+                        time.sleep(0.002)
+                self._total_slots -= self.inflight_per_replica
+                self.engine.retire_replica()
+                self.queue.kick()
+            obsm.SERVE_REPLICAS.set(self.engine.num_replicas)
+            return self.engine.num_replicas
 
     def _dispatch_loop(self, queue: BatchingQueue,
                        gen_stop: threading.Event) -> None:
@@ -848,5 +954,12 @@ class Server:
             # build's cold-start story — hit/miss/skew per bucket
             # executable, plus how many compiles actually ran
             "aot_cache": self.engine.aot_cache_stats,
+            # sustained A/B + autoscaler (absent as None when unused):
+            # per-arm ledgers and the scale decisions with the plan
+            # points they executed — the front door's /stats provenance
+            "ab": (self.abtest.status()
+                   if self.abtest is not None else None),
+            "scaler": (self.scaler.status()
+                       if self.scaler is not None else None),
         })
         return snap

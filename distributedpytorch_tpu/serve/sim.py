@@ -209,6 +209,54 @@ def poisson_arrivals(rate_rps: float, duration_s: float, seed: int = 0,
         out.append((t, int(rows_per_request)))
 
 
+def scheduled_poisson_arrivals(
+    schedule: Sequence[Tuple[float, float]], seed: int = 0,
+    rows_per_request: int = 1,
+) -> List[Tuple[float, int]]:
+    """Piecewise-Poisson arrivals over a rate *schedule*:
+    ``[(duration_s, rate_rps), ...]`` segments walked back-to-back with
+    ONE seeded stream — the synthetic diurnal shape (ramp up, peak,
+    ramp down, trough) the autoscaler tests replay. Deterministic per
+    (schedule, seed); an interarrival gap that straddles a segment
+    boundary keeps the old segment's rate (standard piecewise
+    approximation — fine at the minutes-long segments we generate)."""
+    rng = random.Random(seed)
+    out: List[Tuple[float, int]] = []
+    seg_start = 0.0
+    for duration_s, rate_rps in schedule:
+        rate = max(float(rate_rps), 1e-9)
+        seg_end = seg_start + float(duration_s)
+        t = seg_start
+        while True:
+            t += rng.expovariate(rate)
+            if t >= seg_end:
+                break
+            out.append((t, int(rows_per_request)))
+        seg_start = seg_end
+    return out
+
+
+def write_arrival_trace(path: str,
+                        arrivals: Sequence[Tuple[float, int]],
+                        created_unix: float = 0.0) -> str:
+    """Write a synthetic arrivals list as a ``dpt_serve_arrivals`` v1
+    JSONL — byte-deterministic for a fixed ``created_unix`` (checked-in
+    fixture traces pin 0.0) so regenerating a committed trace is a
+    no-op diff."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "kind": TRACE_KIND, "version": TRACE_VERSION,
+            "created_unix": round(float(created_unix), 3),
+        }) + "\n")
+        for t, rows in arrivals:
+            f.write(json.dumps(
+                {"t": round(float(t), 6), "rows": int(rows)}
+            ) + "\n")
+    return path
+
+
 # -- service-time model ------------------------------------------------------
 class ServiceModel:
     """Per-bucket device-exec sampler calibrated from a loaded

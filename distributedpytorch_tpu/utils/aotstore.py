@@ -55,6 +55,7 @@ from typing import Dict, List, Optional, Tuple
 logger = logging.getLogger(__name__)
 
 ENV_VAR = "DPT_AOT_CACHE"
+KEY_SCHEME_ENV = "DPT_AOT_KEY_SCHEME"
 ENTRY_KIND = "dpt_aot_executable"
 ENTRY_VERSION = 1
 ENTRY_SUFFIX = ".aotx"
@@ -89,6 +90,40 @@ def runtime_versions() -> Dict[str, str]:
         "jaxlib": str(jaxlib.__version__),
         "backend": str(jax.default_backend()),
     }
+
+
+def device_key(device) -> str:
+    """The key's device component for one replica device.
+
+    Default (``exact``) scheme pins ``str(device)`` — the platform's
+    full decoration, e.g. ``TPU_0(process=0,(0,0,0,0))`` — which is
+    always correct but means identical chips in different processes of
+    a pod slice (different coords in the decoration) never share
+    entries. ``DPT_AOT_KEY_SCHEME=kind`` relaxes the component to
+    ``platform:device_kind:ordinal``: same-kind chips at the same local
+    ordinal produce the SAME key across hosts/processes/incarnations,
+    so a shared store dir serves a whole fleet and a scaled-up replica
+    group re-loads the entries any sibling (or a previous incarnation,
+    or ``aot warm``) already persisted.
+
+    The local ordinal stays IN the key under both schemes: a
+    deserialized executable is pinned to its compile-time device and
+    refuses inputs placed anywhere else, so ordinal N's entry is only
+    correct for ordinal N. Skew-refusal semantics are unchanged — the
+    scheme string lands in ``meta["device"]``, is recorded in the entry
+    header, and is re-verified at load like every other meta field."""
+    scheme = (os.environ.get(KEY_SCHEME_ENV) or "exact").strip().lower()
+    if scheme == "kind":
+        platform = getattr(device, "platform", "") or ""
+        kind = getattr(device, "device_kind", "") or platform
+        ordinal = getattr(device, "id", 0)
+        return f"{platform}:{kind}:{int(ordinal)}"
+    if scheme not in ("", "exact"):
+        logger.warning(
+            "unknown $%s=%r — falling back to the exact device-string "
+            "scheme", KEY_SCHEME_ENV, scheme,
+        )
+    return str(device)
 
 
 def entry_key(

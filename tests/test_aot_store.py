@@ -375,3 +375,136 @@ class TestCli:
         monkeypatch.delenv(aotstore.ENV_VAR, raising=False)
         assert aotstore.main(["ls"]) == 2
         assert "DPT_AOT_CACHE" in capsys.readouterr().out
+
+
+class _FakeDevice:
+    """A device stand-in whose ``str()`` decoration is independent of
+    its (platform, kind, ordinal) identity — the pod-slice shape where
+    identical chips in different processes stringify differently."""
+
+    def __init__(self, platform, kind, ordinal, decoration):
+        self.platform = platform
+        self.device_kind = kind
+        self.id = ordinal
+        self._decoration = decoration
+
+    def __str__(self):
+        return self._decoration
+
+
+class TestDeviceKeyScheme:
+    """``DPT_AOT_KEY_SCHEME=kind``: same-kind chips at the same local
+    ordinal share entries across processes/incarnations; the default
+    ``exact`` scheme pins the full device decoration."""
+
+    TWIN_A = _FakeDevice("tpu", "TPU v4", 0, "TPU_0(process=0,(0,0,0,0))")
+    TWIN_B = _FakeDevice("tpu", "TPU v4", 0, "TPU_0(process=1,(1,0,0,0))")
+
+    def test_exact_scheme_splits_identical_chips_across_processes(
+            self, monkeypatch):
+        monkeypatch.delenv(aotstore.KEY_SCHEME_ENV, raising=False)
+        assert aotstore.device_key(self.TWIN_A) == str(self.TWIN_A)
+        assert (aotstore.device_key(self.TWIN_A)
+                != aotstore.device_key(self.TWIN_B))
+
+    def test_kind_scheme_merges_them_but_keeps_the_ordinal(
+            self, monkeypatch):
+        monkeypatch.setenv(aotstore.KEY_SCHEME_ENV, "kind")
+        key = aotstore.device_key(self.TWIN_A)
+        assert key == "tpu:TPU v4:0"
+        assert key == aotstore.device_key(self.TWIN_B)
+        # a deserialized executable only runs on its compile-time
+        # device: the LOCAL ordinal never leaves the key
+        other_ordinal = _FakeDevice("tpu", "TPU v4", 1,
+                                    "TPU_1(process=0,(0,0,0,0))")
+        assert aotstore.device_key(other_ordinal) != key
+
+    def test_kind_scheme_flows_into_distinct_entry_keys(
+            self, monkeypatch):
+        monkeypatch.setenv(aotstore.KEY_SCHEME_ENV, "kind")
+        base = dict(kernels="xla", mask_threshold=None, quantized=False,
+                    stateful=False)
+        shared_a, meta_a = entry_key(
+            FP, 2, (2, 32, 48, 3), "float32",
+            device=aotstore.device_key(self.TWIN_A), **base)
+        shared_b, _ = entry_key(
+            FP, 2, (2, 32, 48, 3), "float32",
+            device=aotstore.device_key(self.TWIN_B), **base)
+        assert shared_a == shared_b  # the fleet-sharing property
+        assert meta_a["device"] == "tpu:TPU v4:0"
+        split, _ = entry_key(
+            FP, 2, (2, 32, 48, 3), "float32",
+            device=aotstore.device_key(
+                _FakeDevice("tpu", "TPU v4", 1, "TPU_1")), **base)
+        assert split != shared_a
+
+    def test_unknown_scheme_warns_and_falls_back_to_exact(
+            self, monkeypatch, caplog):
+        monkeypatch.setenv(aotstore.KEY_SCHEME_ENV, "banana")
+        with caplog.at_level(
+                logging.WARNING,
+                logger="distributedpytorch_tpu.utils.aotstore"):
+            key = aotstore.device_key(self.TWIN_A)
+        assert key == str(self.TWIN_A)
+        assert any("banana" in rec.message for rec in caplog.records)
+
+    def test_kind_scheme_second_startup_zero_compiles(
+            self, pieces, tmp_path, monkeypatch):
+        """The warm-store acceptance lever holds under the kind scheme
+        too — and the persisted entries carry kind-format device
+        components, so skew verification sees the scheme it was
+        written under."""
+        monkeypatch.setenv(aotstore.KEY_SCHEME_ENV, "kind")
+        root = tmp_path / "store"
+        cold = make_engine(pieces, root)
+        assert cold.aot_compiles == len(BUCKETS)
+        device = cold.replicas[0].device
+        _, meta = cold._entry_key(BUCKETS[0], device)
+        assert meta["device"] == aotstore.device_key(device)
+        assert ":" in meta["device"]  # kind-format, not a decoration
+        warm_engine = make_engine(pieces, root)
+        assert warm_engine.aot_compiles == 0
+        assert warm_engine.aot_cache_stats["hit"] == len(BUCKETS)
+        assert warm_engine.aot_cache_stats["skew"] == 0
+
+    def test_kind_scheme_keeps_runtime_skew_refusal(
+            self, pieces, tmp_path, monkeypatch):
+        """Relaxing the DEVICE component must not relax the RUNTIME
+        cross-check: a faked jaxlib bump still refuses every entry
+        loudly instead of serving a stale executable."""
+        monkeypatch.setenv(aotstore.KEY_SCHEME_ENV, "kind")
+        root = tmp_path / "store"
+        make_engine(pieces, root)
+        real = aotstore.runtime_versions()
+        monkeypatch.setattr(
+            aotstore, "runtime_versions",
+            lambda: {**real, "jaxlib": "99.99.99"})
+        bumped = make_engine(pieces, root)
+        assert bumped.aot_compiles == len(BUCKETS)
+        assert bumped.aot_cache_stats["skew"] == len(BUCKETS)
+
+
+class TestScaledReplicaWarmStore:
+    def test_re_added_replica_loads_instead_of_compiling(
+            self, pieces, tmp_path):
+        """The autoscaler's grow path rides the store: the FIRST grow
+        onto a device compiles (ordinal 1 had no entries), but after a
+        shrink the next grow re-loads what that ordinal persisted —
+        zero compiles, which is what makes scale-up cheap enough to
+        actuate from a control loop."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices (conftest forces 8 on CPU)")
+        root = tmp_path / "store"
+        engine = make_engine(pieces, root)
+        base = engine.aot_compiles
+        assert base == len(BUCKETS)
+        engine.add_replica()  # ordinal 1, cold: compile + persist
+        after_first_grow = engine.aot_compiles
+        assert after_first_grow == base + len(BUCKETS)
+        engine.retire_replica()
+        engine.add_replica()  # ordinal 1 again, warm: pure loads
+        assert engine.aot_compiles == after_first_grow
+        assert engine.aot_cache_stats["hit"] >= len(BUCKETS)
+        assert engine.num_replicas == 2

@@ -445,6 +445,10 @@ class TestStatsSchema:
         # AOT executable store addition (ISSUE 16, deliberate schema
         # growth): this engine build's cold-start hit/miss/skew story
         "aot_cache",
+        # front-door additions (ISSUE 17, deliberate schema growth):
+        # sustained A/B arm ledgers and autoscale decision provenance,
+        # both None when the feature is unused
+        "ab", "scaler",
     }
 
     def test_stats_key_set_and_types_pinned(self, engine):
@@ -485,6 +489,9 @@ class TestStatsSchema:
             }
             assert aot["enabled"] is False
             assert aot["compiles"] == len(stats["buckets"])
+            # no A/B and no scaler attached to this bare server
+            assert stats["ab"] is None
+            assert stats["scaler"] is None
             json.dumps(stats)  # JSON-serializable end to end
         finally:
             server.stop()

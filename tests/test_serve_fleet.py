@@ -588,6 +588,22 @@ class TestAutoscaleHint:
         hint.observe_window(9, 0)
         assert obsm.SERVE_REPLICA_HINT.value == 3
 
+    def test_stale_fleet_metrics_count_as_pressure(self):
+        """A worker that stops answering the metrics scrape is load you
+        cannot SEE, not load that vanished: stale windows arm the
+        up-streak like sheds do, and break any quiet streak — the fleet
+        never scales down on blindness."""
+        hint = self._hint(replicas=2, up_windows=2)
+        assert hint.observe_window(0, 0, stale=True) == 2
+        assert hint.observe_window(0, 0, stale=True) == 3
+        quiet = self._hint(replicas=2, down_windows=2)
+        assert quiet.observe_window(0, 0) == 2
+        # one blind window resets the quiet streak...
+        assert quiet.observe_window(0, 0, stale=True) == 2
+        assert quiet.observe_window(0, 0) == 2
+        # ...so the down takes a FULL fresh quiet run after sight returns
+        assert quiet.observe_window(0, 0) == 1
+
 
 # ---------------------------------------------------------------------------
 # HTTP front: Retry-After, readiness vs liveness, /admin/rollout
@@ -1006,4 +1022,147 @@ class TestBenchServeFleetLegs:
         assert rollout["zero_5xx"]
         assert rollout["weights_version"] == 1
         assert os.path.exists(rollout["flight_recorder"])
+        router = report["router"]
+        assert router["requests"] > 0
+        assert router["zero_client_failures"]
+        assert os.path.exists(router["flight_recorder"])
         json.dumps(report)  # still a writable JSON artifact
+
+
+# ---------------------------------------------------------------------------
+# live replica-group scaling + sustained weight A/B
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaResize:
+    def test_grow_serves_then_shrink_drains(self, engine):
+        """``resize_replicas`` is the autoscaler's actuator: grow makes
+        the next flush able to land on the new replica, shrink drains
+        the victim's slots before dropping it — both mid-serve, no
+        restart, and the server keeps answering through each."""
+        server = _serve(engine)
+        try:
+            assert engine.num_replicas == 1
+            assert server.resize_replicas(2) == 2
+            assert server.stats()["replicas"] == 2
+            resp = server.submit([_img(i) for i in range(4)]).result(30)
+            assert resp.ok and len(resp.masks) == 4
+            assert server.resize_replicas(1) == 1
+            assert engine.num_replicas == 1
+            resp = server.submit(_img(9)).result(30)
+            assert resp.ok
+        finally:
+            server.stop()
+            while engine.num_replicas > 1:  # the fixture is shared
+                engine.retire_replica()
+
+    def test_resize_floors_at_one(self, engine):
+        server = _serve(engine)
+        try:
+            assert server.resize_replicas(0) == 1
+            assert engine.num_replicas == 1
+        finally:
+            server.stop()
+
+
+class TestSustainedAB:
+    def _ab(self, server, **kwargs):
+        from distributedpytorch_tpu.serve.rollout import ABTest
+
+        ab = ABTest(server, **kwargs)
+        server.abtest = ab
+        return ab
+
+    def test_needs_two_replica_groups(self, rigs, engine):
+        from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+
+        _tmp, _dir_a, dir_b, _images = rigs
+        server = _serve(engine)
+        try:
+            ab = self._ab(server)
+            with pytest.raises(ValueError, match="replica groups"):
+                ab.start(resolve_checkpoint("singleGPU", dir_b))
+            assert not ab.active
+            assert server.ab_arms is None
+        finally:
+            server.stop()
+
+    def test_arms_pin_groups_split_traffic_and_promote_winner(
+            self, rigs, engine, pristine_weights):
+        """The sustained-A/B lifecycle on a live 2-replica server:
+        disjoint replica groups pinned per arm, traffic split by the
+        deterministic request-id hash with per-arm ledgers, explicit
+        ``X-AB-Arm``-shaped placement landing on the arm's OWN weights,
+        resize refused while arms pin the groups, and ``stop(winner)``
+        promoting the winner fleet-wide as a pointer flip."""
+        from distributedpytorch_tpu.checkpoint import resolve_checkpoint
+        from distributedpytorch_tpu.obs import defs as obsm
+        from distributedpytorch_tpu.serve.rollout import ab_arm_for
+
+        _tmp, _dir_a, dir_b, _images = rigs
+        server = _serve(engine)
+        ab = None
+        try:
+            assert server.resize_replicas(2) == 2
+            probe_rows = [_img(100 + i) for i in range(3)]
+            ab = self._ab(server, probe_rows=probe_rows, split=0.5)
+            status = ab.start(resolve_checkpoint("singleGPU", dir_b),
+                              label="candidate-b")
+            assert ab.active and status["active"]
+            assert server.ab_arms == {"a": frozenset([0]),
+                                      "b": frozenset([1])}
+            assert engine.versions_mixed  # two promoted versions, pinned
+            assert obsm.SERVE_AB_ACTIVE.value == 1
+            # resizing would tear a group boundary: refused, not queued
+            assert server.resize_replicas(3) == 2
+
+            rids = [f"ab-req-{i}" for i in range(12)]
+            for i, rid in enumerate(rids):
+                resp = server.submit(_img(i % 4), request_id=rid).result(30)
+                assert resp.ok
+            expected = {"a": 0, "b": 0}
+            for rid in rids:
+                expected[ab_arm_for(rid, 0.5)] += 1
+            snap = server.metrics.ab_snapshot()
+            for arm, n in expected.items():
+                if n:
+                    assert snap[arm]["requests_ok"] == n
+                    assert snap[arm]["p50_ms"] is not None
+
+            # explicit arm placement lands on that arm's own weights
+            row = _img(99)
+            for arm, idx in (("a", 0), ("b", 1)):
+                served = server.submit(row, arm=arm).result(30)
+                assert served.ok
+                ref = engine.postprocess(
+                    engine.infer(np.stack([row]), replica_index=idx)[0]
+                )
+                np.testing.assert_array_equal(served.masks[0], ref)
+
+            verdict = ab.verdict()
+            assert verdict["active"]
+            assert 0.0 <= verdict["inter_arm_dice"] <= 1.0
+            assert set(verdict["arms"]) == {"a", "b"}
+
+            version_b = ab.versions["b"]
+            out = ab.stop(winner="b")
+            assert out["stopped"] and out["winner"] == "b"
+            assert not ab.active
+            assert server.ab_arms is None
+            assert not engine.versions_mixed
+            assert all(r.weights_version == version_b
+                       for r in engine.replicas)
+            assert obsm.SERVE_AB_ACTIVE.value == 0
+            # the promoted fleet serves the candidate everywhere now
+            served = server.submit(row).result(30)
+            ref_b = engine.postprocess(
+                engine.infer(np.stack([row]), replica_index=0)[0]
+            )
+            np.testing.assert_array_equal(served.masks[0], ref_b)
+        finally:
+            if ab is not None and ab.active:
+                ab.stop()
+            server.resize_replicas(1)
+            server.stop()
+            while engine.num_replicas > 1:  # the fixture is shared
+                engine.retire_replica()

@@ -532,12 +532,160 @@ def rollout_leg(engine, args, duration_s: float) -> dict:
     }
 
 
+def router_leg(engine, args, duration_s: float) -> dict:
+    """Front-door drill: two HTTP workers (each the SAME Server+handler
+    stack the production CLI runs) behind a serve/router.py Router, a
+    closed loop of clients talking ONLY to the router's address, and
+    two mid-traffic failures — a ``serve_dispatch_death`` chaos kill of
+    one worker's dispatch core (503s while it relaunches) and an abrupt
+    teardown+rebind of the other worker's HTTP front (connection
+    failures → eject, then readmit on recovery). The acceptance number
+    is **zero client-visible failures**: every request answers 200,
+    failures surface only as the router's transparent retries. The row
+    also stamps one explicit scale-up/down cycle through the replica
+    scaler when the device pool allows it."""
+    import http.client
+    import io
+
+    import jax
+    from PIL import Image
+
+    from distributedpytorch_tpu.obs import flight
+    from distributedpytorch_tpu.serve.autoscale import AutoscaleHint
+    from distributedpytorch_tpu.serve.cli import make_http_server
+    from distributedpytorch_tpu.serve.router import Router
+    from distributedpytorch_tpu.serve.scaler import ReplicaScaler
+    from distributedpytorch_tpu.utils import faults
+
+    engine_b = build_engine(args)
+    server_a = _new_server(engine, args)
+    server_b = _new_server(engine_b, args)
+    httpd_a = make_http_server(server_a, port=0)
+    httpd_b = make_http_server(server_b, port=0)
+    port_a = httpd_a.server_address[1]
+    port_b = httpd_b.server_address[1]
+    for httpd in (httpd_a, httpd_b):
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    router = Router(
+        [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+        retry_budget=6, backoff_base_s=0.02, backoff_cap_s=0.5,
+        hedge=True, probe_interval_s=0.2,
+    ).start()
+
+    img8 = (make_images(1, engine.input_hw, args.seed)[0] * 255.0)
+    buf = io.BytesIO()
+    Image.fromarray(img8.astype(np.uint8)).save(buf, format="PNG")
+    body = buf.getvalue()
+
+    from distributedpytorch_tpu.serve.router import make_router_http
+
+    router_httpd = make_router_http(router, port=0)
+    router_port = router_httpd.server_address[1]
+    threading.Thread(target=router_httpd.serve_forever,
+                     daemon=True).start()
+
+    codes: dict = {}
+    transport_errors = 0
+    lock = threading.Lock()
+    stop_at = time.monotonic() + duration_s
+
+    def client(wid: int) -> None:
+        nonlocal transport_errors
+        while time.monotonic() < stop_at:
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", router_port, timeout=60.0)
+            try:
+                conn.request("POST", "/predict", body=body,
+                             headers={"Content-Type": "image/png"})
+                resp = conn.getresponse()
+                resp.read()
+                with lock:
+                    codes[resp.status] = codes.get(resp.status, 0) + 1
+            except Exception:  # noqa: BLE001 — a client-side transport
+                # failure IS a client-visible failure
+                with lock:
+                    transport_errors += 1
+            finally:
+                try:
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            time.sleep(0.002)
+
+    # one explicit, plan-shaped scale cycle when the device pool allows
+    hint = AutoscaleHint(server_a, interval_s=1e9)
+    scaler = ReplicaScaler(server_a, hint, cooldown_windows=0)
+    server_a.scaler = scaler
+    base_replicas = engine.num_replicas
+    scaled = False
+
+    threads = [threading.Thread(target=client, args=(w,), daemon=True)
+               for w in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        # failure 1 (~30%): one dispatch core dies; its worker 503s
+        # while relaunching and the router retries onto the sibling
+        time.sleep(duration_s * 0.3)
+        faults.install(("serve_dispatch_death",))
+        if len(jax.devices()) > base_replicas:
+            scaler.apply(scaler.decide(base_replicas + 1))
+            scaled = engine.num_replicas == base_replicas + 1
+        # failure 2 (~60%): abrupt HTTP-front teardown (the in-process
+        # SIGKILL analogue) → connection failures → eject; rebinding
+        # the same port brings it back → /healthz readmit
+        time.sleep(duration_s * 0.3)
+        httpd_b.shutdown()
+        httpd_b.server_close()
+        time.sleep(max(0.5, duration_s * 0.1))
+        httpd_b = make_http_server(server_b, port=port_b)
+        threading.Thread(target=httpd_b.serve_forever,
+                         daemon=True).start()
+        if scaled:
+            scaler.apply(scaler.decide(base_replicas))
+        for t in threads:
+            t.join(timeout=duration_s + 120.0)
+    finally:
+        faults.reset()
+        artifact = flight.dump("bench_serve_router",
+                               path=_flight_path(args, "router"))
+        router_httpd.shutdown()
+        router.stop()
+        for httpd in (httpd_a, httpd_b):
+            try:
+                httpd.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        server_a.stop(drain=True)
+        server_b.stop(drain=True)
+    stats = router.stats()
+    non_200 = sum(n for code, n in codes.items() if code != 200)
+    return {
+        "mode": "router",
+        "requests": sum(codes.values()),
+        "codes": {str(code): n for code, n in sorted(codes.items())},
+        "transport_errors": transport_errors,
+        "zero_client_failures": non_200 == 0 and transport_errors == 0,
+        "retries": stats["retries"],
+        "hedges_fired": stats["hedges_fired"],
+        "hedge_wins": stats["hedge_wins"],
+        "scale_ups": scaler.scale_ups,
+        "scale_downs": scaler.scale_downs,
+        "scale_decisions": scaler.decisions[-4:],
+        "router_p99_ms": stats["p99_ms"],
+        "core_restarts": server_a.core_restarts + server_b.core_restarts,
+        "flight_recorder": artifact,
+    }
+
+
 def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None,
               levels: Optional[Sequence[int]] = None) -> dict:
     """The whole program: closed-loop sweep over the concurrency levels,
     one in-SLO open-loop run, one overload run, then the fleet drills —
-    a chaos leg (dispatch death → relaunch) and a rollout leg
-    (mid-traffic canaried weight swap). Returns the report dict
+    a chaos leg (dispatch death → relaunch), a rollout leg (mid-traffic
+    canaried weight swap), and a router leg (two HTTP workers behind the
+    front-door router, mid-traffic failures, zero client-visible
+    errors). Returns the report dict
     (bench_multi appends it to the session artifact verbatim)."""
     args = args or get_args([])
     levels = [int(c) for c in (levels or args.levels)]
@@ -546,9 +694,9 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
     engine = build_engine(args)
     engine.warmup()
 
-    # budget split: levels + 2 open-loop scenarios + 2 fleet drills,
+    # budget split: levels + 2 open-loop scenarios + 3 fleet drills,
     # capped per-leg
-    legs = len(levels) + 4
+    legs = len(levels) + 5
     leg_s = max(1.0, min(args.duration, (budget_s * 0.8) / legs))
 
     report = {
@@ -591,6 +739,8 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
     print(json.dumps(report["chaos"]), flush=True)
     report["rollout"] = rollout_leg(engine, args, leg_s)
     print(json.dumps(report["rollout"]), flush=True)
+    report["router"] = router_leg(engine, args, leg_s)
+    print(json.dumps(report["router"]), flush=True)
     report["elapsed_s"] = round(time.monotonic() - t_start, 2)
     report["value"] = capacity  # headline: peak closed-loop imgs/s
     return report
@@ -637,8 +787,9 @@ def main(argv=None) -> int:
             f.write(text + "\n")
     print(text)
     # acceptance: >= 3 levels reported, overload depth bounded, the
-    # chaos drill relaunched with zero hung futures, and the mid-traffic
-    # rollout promoted with zero 5xx-shaped answers
+    # chaos drill relaunched with zero hung futures, the mid-traffic
+    # rollout promoted with zero 5xx-shaped answers, and the router
+    # drill absorbed both failures with zero client-visible failures
     ok = (
         len(report["levels"]) >= 3
         and report["overload"]["depth_bounded"]
@@ -646,6 +797,8 @@ def main(argv=None) -> int:
         and report["chaos"]["unresolved_futures"] == 0
         and report["rollout"]["outcome"] == "promoted"
         and report["rollout"]["zero_5xx"]
+        and report["router"]["zero_client_failures"]
+        and report["router"]["requests"] > 0
     )
     return 0 if ok else 1
 
