@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "gate — catches collectives gated on ranks the "
                          "dual-rank re-trace never simulates); "
                          "0 = off, needs N >= 2")
+    ap.add_argument("--plan", default=None, metavar="PATH",
+                    help="Check a saved dpt_plan for staleness: re-trace "
+                         "every fingerprinted point and flag rows whose "
+                         "ordered-collective fingerprint no longer "
+                         "matches the current trace (rule stale-plan — "
+                         "a drifted plan ranks legs from a program that "
+                         "no longer exists); requires the collectives "
+                         "layer")
     ap.add_argument("--no-rank-check", action="store_true",
                     help="Skip the simulated-rank re-trace (halves trace "
                          "count; the dual-rank check is what catches "
@@ -114,6 +122,12 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         # false confidence _fingerprint_world exists to prevent
         print("analyze: --fingerprint-world requires the collectives "
               "layer (--layer all|collectives)", file=sys.stderr)
+        return EXIT_INFRA
+    if args.plan and args.layer == "lint":
+        # same contract: the stale-plan re-trace IS a collectives-layer
+        # check — skipping it silently would report a drifted plan clean
+        print("analyze: --plan requires the collectives layer "
+              "(--layer all|collectives)", file=sys.stderr)
         return EXIT_INFRA
     t0 = time.monotonic()
     findings: List = []
@@ -156,6 +170,20 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
                     world=args.fingerprint_world,
                 )
                 findings += ffindings
+            if args.plan:
+                from distributedpytorch_tpu.analysis.planner import (
+                    check_plan_staleness,
+                    load_plan,
+                )
+
+                payload = load_plan(args.plan)
+                if payload is None:
+                    # a missing/corrupt/version-skewed plan is a bad
+                    # invocation, not a clean plan
+                    print(f"analyze: --plan {args.plan}: not a readable "
+                          f"dpt_plan artifact", file=sys.stderr)
+                    return EXIT_INFRA
+                findings += check_plan_staleness(payload)
         if args.layer in ("all", "lint"):
             from distributedpytorch_tpu.analysis import lint
 
@@ -173,6 +201,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         "fingerprints": fingerprints,
         "lint_files": lint_files,
         "hlo": bool(args.hlo),
+        "plan": args.plan,
         "duration_s": round(time.monotonic() - t0, 2),
     }
     out = sys.stderr if args.json_path == "-" else sys.stdout

@@ -649,24 +649,32 @@ def check_rank_invariance(method: str, schedule: Optional[str],
 
 
 # -- collective fingerprints (the multi-process preflight's desync gate) ----
-def collective_fingerprint(method: str, schedule: Optional[str] = None,
-                           process_index: int = 0) -> str:
-    """A short stable hash of one combo's ORDERED collective program —
-    kind, axes, permutation, enclosing-eqn context, and per-device
-    payload bytes of every collective, in program order — traced under
-    the given simulated process identity. Two ranks whose fingerprints
-    differ would trace different programs in a real launch and desync
-    the gloo rendezvous at the first unmatched collective."""
+def program_fingerprint(colls: Sequence) -> str:
+    """A short stable hash of an ORDERED collective program — kind,
+    axes, permutation, enclosing-eqn context, and per-device payload
+    bytes of every collective, in program order. The one definition
+    shared by the multi-process desync gate (same program on every
+    rank) and the planner's per-point provenance stamp (same program
+    the plan was built from — the ``stale-plan`` rule's comparator)."""
     import hashlib
 
+    payload = repr([(c.signature, c.payload_bytes) for c in colls])
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def collective_fingerprint(method: str, schedule: Optional[str] = None,
+                           process_index: int = 0) -> str:
+    """One combo's :func:`program_fingerprint`, traced under the given
+    simulated process identity. Two ranks whose fingerprints differ
+    would trace different programs in a real launch and desync the
+    gloo rendezvous at the first unmatched collective."""
     import jax
 
     with unittest.mock.patch.object(
         jax, "process_index", lambda: int(process_index)
     ):
         colls = extract_collectives(trace_train(method, schedule))
-    payload = repr([(c.signature, c.payload_bytes) for c in colls])
-    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+    return program_fingerprint(colls)
 
 
 def check_collective_fingerprints(

@@ -247,17 +247,17 @@ def _flops_of(compiled) -> Optional[float]:
     return flops if flops > 0 else None
 
 
-def evaluate_point(point: PlanPoint, image_size, widths,
-                   mesh_model: cm.MeshModel, hbm_budget_bytes: int) -> dict:
-    """One point's row: abstract state → jaxpr comms program → AOT
-    compile → memory/flops → cost. Zero device execution throughout
-    (``make_jaxpr`` + ``lower().compile()`` only). Raises on configs the
-    strategy itself rejects — the caller records those as infeasible."""
+def _trace_point_step(point: PlanPoint, image_size, widths):
+    """The point's abstract train step, traced: config → strategy →
+    shape-only state/batch → jaxpr collective program. Shared by
+    :func:`evaluate_point` (which goes on to AOT-compile) and
+    :func:`check_plan_staleness` (which only needs the collective
+    program) so the stale-plan re-trace compares like with like.
+    Returns ``(cfg, strategy, model, tx, state, batch, colls)``."""
     import jax
     import jax.numpy as jnp
 
     from distributedpytorch_tpu.analysis.collectives import (
-        compile_train_step_aot,
         extract_collectives,
     )
     from distributedpytorch_tpu.models import create_model
@@ -296,12 +296,33 @@ def evaluate_point(point: PlanPoint, image_size, widths,
             (point.batch, height, width, 3), jnp.float32),
         "mask": jax.ShapeDtypeStruct((point.batch, height, width), jnp.int32),
     }
-
-    # -- comms program: jaxpr-extracted (explicit schedules) or analytic ----
-    mesh = strategy.mesh
     colls = extract_collectives(
         jax.make_jaxpr(strategy._raw_step(model, tx))(state, batch)
     )
+    return cfg, strategy, model, tx, state, batch, colls
+
+
+def evaluate_point(point: PlanPoint, image_size, widths,
+                   mesh_model: cm.MeshModel, hbm_budget_bytes: int) -> dict:
+    """One point's row: abstract state → jaxpr comms program → AOT
+    compile → memory/flops → cost. Zero device execution throughout
+    (``make_jaxpr`` + ``lower().compile()`` only). Raises on configs the
+    strategy itself rejects — the caller records those as infeasible."""
+    import jax.numpy as jnp
+
+    from distributedpytorch_tpu.analysis.collectives import (
+        compile_train_step_aot,
+        program_fingerprint,
+    )
+
+    cfg, strategy, model, tx, state, batch, colls = _trace_point_step(
+        point, image_size, widths
+    )
+    policy = strategy.policy
+    params = state.params
+
+    # -- comms program: jaxpr-extracted (explicit schedules) or analytic ----
+    mesh = strategy.mesh
     program: List[cm.CommOp] = []
     last_sig = None
     for c in colls:
@@ -387,6 +408,12 @@ def evaluate_point(point: PlanPoint, image_size, widths,
 
     row = point.as_dict()
     row.update(feasible=feasible, reject=reject, predicted=predicted)
+    # provenance stamp: the ordered-collective fingerprint of the trace
+    # this row's numbers were computed from — the stale-plan rule
+    # (check_plan_staleness) re-traces and compares against it. Only
+    # xla rows trace; kernel-derived rows copy their twin's artifacts
+    # and deliberately carry no fingerprint.
+    row["jaxpr_fingerprint"] = program_fingerprint(colls)
     return row
 
 
@@ -676,6 +703,90 @@ def load_plan(path: str) -> Optional[dict]:
     if not isinstance(payload.get("points"), list):
         return None
     return payload
+
+
+def point_from_row(row: Mapping) -> PlanPoint:
+    """The :class:`PlanPoint` coordinates a saved plan row was
+    evaluated at (the inverse of ``PlanPoint.as_dict``)."""
+    return PlanPoint(
+        strategy=row["strategy"],
+        schedule=row.get("schedule"),
+        microbatches=row.get("microbatches"),
+        s2d_levels=int(row.get("s2d_levels") or 0),
+        remat=bool(row.get("remat")),
+        batch=int(row["batch"]),
+        dtype=row["dtype"],
+        kernels=row.get("kernels", "xla"),
+    )
+
+
+def check_plan_staleness(payload: Mapping) -> List:
+    """The ``stale-plan`` rule (dptlint, collectives layer): re-trace
+    every fingerprinted point of a loaded ``dpt_plan`` at the plan's
+    own image size/widths and flag rows whose per-point ordered-
+    collective fingerprint (``jaxpr_fingerprint``, stamped by
+    :func:`evaluate_point`) no longer matches the current trace.
+
+    A drifted fingerprint means the code that traces the train step —
+    strategy, model, optimizer wrapping, sharding rules — changed
+    since the plan was built: its rankings and comms predictions
+    describe a program that no longer exists, and acting on them
+    (bench_multi leg ordering, preflight gates) is planning from
+    fiction. Rows without a fingerprint (kernel-derived points, plans
+    predating the stamp) are skipped — no trace, nothing to compare.
+    Infeasible-at-plan-time rows are still checked when they carry a
+    fingerprint: their *rejection* was also computed from the trace."""
+    from distributedpytorch_tpu.analysis import Finding
+
+    from distributedpytorch_tpu.analysis.collectives import (
+        program_fingerprint,
+    )
+
+    findings: List[Finding] = []
+    image_size = tuple(payload.get("image_size") or (960, 640))
+    widths = payload.get("widths")
+    for row in payload.get("points") or []:
+        if not isinstance(row, Mapping):
+            continue
+        want = row.get("jaxpr_fingerprint")
+        if not want:
+            continue
+        point = point_from_row(row)
+        where = row.get("key") or point.key
+        try:
+            colls = _trace_point_step(point, image_size, widths)[-1]
+        except AnalysisEnvironmentError:
+            raise  # broken analyzer environment, not a stale plan
+        except Exception as exc:  # noqa: BLE001 — the point no longer
+            # builds at all: the strongest possible staleness signal
+            findings.append(Finding(
+                rule="stale-plan",
+                where=where,
+                message=(
+                    f"plan point no longer traces "
+                    f"({type(exc).__name__}: {exc}) — the loaded "
+                    f"dpt_plan predates the current code; re-run the "
+                    f"planner"
+                ),
+                layer="collectives",
+            ))
+            continue
+        got = program_fingerprint(colls)
+        if got != want:
+            findings.append(Finding(
+                rule="stale-plan",
+                where=where,
+                message=(
+                    f"collective fingerprint drifted: the plan recorded "
+                    f"{want} but the current trace is {got} — this "
+                    f"row's cost/comms numbers (and the plan's ranking) "
+                    f"were computed from a collective program that no "
+                    f"longer exists; re-run the planner before trusting "
+                    f"the plan"
+                ),
+                layer="collectives",
+            ))
+    return findings
 
 
 # -- bench_multi leg mapping (jax-free) --------------------------------------

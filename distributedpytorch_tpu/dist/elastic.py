@@ -245,6 +245,191 @@ class FleetMetricsScraper:
         self._stop.set()
 
 
+class FleetScaler:
+    """Supervisor-level capacity actuator: spawn/retire WHOLE serve
+    workers (the process-level loop plan-serve actually sizes — the
+    in-process :class:`serve.scaler.ReplicaScaler` only resizes replica
+    groups *inside* one worker). Both actuators share one control law
+    (serve/control.py): every decision cites the ``dpt_serve_plan``
+    grid point it executes, exactly like the replica scaler's.
+
+    The recommendation signal is the plan itself — the observed fleet
+    arrival rate matched to the nearest simulated poisson scenario at
+    or above it, that scenario's recommended replica count read as a
+    worker count (one worker hosts one planned replica's capacity at
+    fleet granularity). Streak hysteresis (``up_windows`` consecutive
+    diverging windows to grow, ``down_windows`` to shrink — shrinking
+    is the dangerous direction) plus the shared cooldown keep it from
+    flapping; one worker moves per actuation.
+
+    Spawn rides the per-rank relaunch machinery: fresh port base+R, an
+    attempt-0 heartbeat slot, and the fleet-shared ``$DPT_AOT_CACHE`` —
+    the newcomer cold-starts warm off the executables its siblings
+    already compiled (``recompiles: 0``). Retire drains via the
+    router(s): eject from every front door, wait out in-flight, THEN
+    SIGTERM (serve/cli.py drains on it)."""
+
+    def __init__(self, supervisor: "ElasticSupervisor", plan=None,
+                 min_workers: int = 1, max_workers: Optional[int] = None,
+                 up_windows: int = 2, down_windows: int = 4,
+                 cooldown_windows: Optional[int] = None):
+        from distributedpytorch_tpu.serve.control import (  # jax-free
+            plan_recommendation,
+        )
+
+        self._recommend = plan_recommendation
+        if isinstance(plan, str):
+            from distributedpytorch_tpu.analysis.serve_planner import (
+                load_serve_plan,  # jax-free: profile + sim only
+            )
+
+            plan = load_serve_plan(plan)
+        self.supervisor = supervisor
+        self.plan = plan
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = int(
+            max_workers if max_workers is not None
+            else max(supervisor.nprocs, self.min_workers)
+        )
+        self.up_windows = max(1, int(up_windows))
+        self.down_windows = max(1, int(down_windows))
+        self.cooldown_windows = int(
+            cooldown_windows if cooldown_windows is not None
+            else max(self.up_windows, self.down_windows)
+        )
+        # start past cooldown: the FIRST sustained divergence may act
+        self.windows_since_action = self.cooldown_windows
+        self._up_streak = 0
+        self._down_streak = 0
+        self.decisions: List[dict] = []
+        self.spawns = 0
+        self.retires = 0
+        # arrival-rate observation (thread mode): router request deltas
+        self._last_requests: Optional[int] = None
+        self._last_t: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def step(self, observed_rate_rps: Optional[float] = None):
+        """One control window: age the cooldown, read the plan's
+        recommendation for the observed rate, decide through the shared
+        law, actuate at most one worker."""
+        from distributedpytorch_tpu.serve import control
+
+        self.windows_since_action += 1
+        current = len(self.supervisor.active_serve_ranks())
+        recommendation = self._recommend(self.plan, observed_rate_rps)
+        hold_reason = None
+        if recommendation is not None:
+            if recommendation > current:
+                self._up_streak += 1
+                self._down_streak = 0
+                if self._up_streak < self.up_windows:
+                    hold_reason = (
+                        f"up streak {self._up_streak}/{self.up_windows}")
+            elif recommendation < current:
+                self._down_streak += 1
+                self._up_streak = 0
+                if self._down_streak < self.down_windows:
+                    hold_reason = (f"down streak {self._down_streak}/"
+                                   f"{self.down_windows}")
+            else:
+                self._up_streak = self._down_streak = 0
+        decision = control.decide_scale(
+            current, recommendation,
+            min_units=self.min_workers, max_units=self.max_workers,
+            windows_since_action=self.windows_since_action,
+            cooldown_windows=self.cooldown_windows,
+            hold_reason=hold_reason,
+            rate_rps=observed_rate_rps, plan=self.plan,
+        )
+        return self.apply(decision)
+
+    def apply(self, decision):
+        """Actuate a non-hold decision: one worker per window, through
+        the supervisor's spawn/retire machinery. Stamps the ledger /
+        flight / metric trail either way."""
+        import dataclasses as _dc
+
+        from distributedpytorch_tpu.serve import control
+
+        achieved = decision.current
+        if decision.direction != control.DIR_HOLD:
+            if decision.direction == control.DIR_UP:
+                rank = self.supervisor.spawn_fleet_worker()
+                if rank is not None:
+                    achieved = decision.current + 1
+                    self.spawns += 1
+            else:
+                rank = self.supervisor.retire_fleet_worker()
+                if rank is not None:
+                    achieved = decision.current - 1
+                    self.retires += 1
+            if achieved != decision.current:
+                self.windows_since_action = 0
+                self._up_streak = self._down_streak = 0
+                obsm.FLEET_SCALE_EVENTS.labels(
+                    direction=decision.direction).inc()
+                logger.info(
+                    "fleet scaler: %s %d -> %d (%s) plan_point=%s",
+                    decision.direction, decision.current, achieved,
+                    decision.reason, decision.plan_point,
+                )
+            entry = {**decision.payload(), "achieved": achieved}
+            self.decisions.append(entry)
+            del self.decisions[:-50]
+            flight.record("fleet_scale", **{
+                k: v for k, v in entry.items() if v is not None})
+        return _dc.replace(decision, target=achieved)
+
+    # -- background thread (elastic --fleet-interval) ------------------------
+    def _observed_rate(self) -> Optional[float]:
+        router = self.supervisor.router
+        if router is None:
+            return None
+        now = time.monotonic()
+        total = router.requests_ok + router.requests_failed
+        rate = None
+        if self._last_requests is not None and now > self._last_t:
+            rate = (total - self._last_requests) / (now - self._last_t)
+        self._last_requests, self._last_t = total, now
+        return rate
+
+    def _run(self, interval_s: float) -> None:
+        while not self._stop.wait(interval_s):
+            try:
+                self.step(observed_rate_rps=self._observed_rate())
+            except Exception:  # noqa: BLE001 — the control loop must
+                # outlive one bad window
+                logger.exception("fleet scaler: step failed")
+
+    def start(self, interval_s: float) -> "FleetScaler":
+        self._thread = threading.Thread(
+            target=self._run, args=(float(interval_s),),
+            name="dpt-fleet-scaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def status(self) -> dict:
+        return {
+            "workers": len(self.supervisor.active_serve_ranks()),
+            "min_workers": self.min_workers,
+            "max_workers": self.max_workers,
+            "cooldown_windows": self.cooldown_windows,
+            "windows_since_action": self.windows_since_action,
+            "spawns": self.spawns,
+            "retires": self.retires,
+            "plan": bool(self.plan),
+            "decisions": self.decisions[-10:],
+        }
+
+
 @dataclasses.dataclass
 class AttemptResult:
     """What one launch attempt came to (recorded in the report JSON)."""
@@ -293,6 +478,11 @@ class ElasticSupervisor:
         metrics_port: Optional[int] = None,
         workload: str = "train",
         router_port: Optional[int] = None,
+        router_standby_port: Optional[int] = None,
+        fleet_plan=None,
+        fleet_min_workers: int = 1,
+        fleet_max_workers: Optional[int] = None,
+        fleet_interval_s: float = 0.0,
     ):
         if nprocs < 1:
             raise ValueError(f"nprocs must be >= 1, got {nprocs}")
@@ -352,6 +542,22 @@ class ElasticSupervisor:
         # process). None = clients talk to worker ports directly.
         self.router_port = router_port
         self.router = None
+        # HA pair (--router-standby-port): a SECOND router instance —
+        # both proxy /predict at all times; the standby pulls the
+        # active's /admin/state snapshot every probe interval and takes
+        # over on the first missed probe (serve/router.py "HA"). The
+        # client contract is two addresses, no VIP (docs/SERVING.md).
+        self.router_standby_port = router_standby_port
+        self.standby_router = None
+        # fleet-level elasticity (FleetScaler): spawn/retire whole
+        # serve workers off the plan-serve recommendation
+        self.fleet_plan = fleet_plan
+        self.fleet_min_workers = int(fleet_min_workers)
+        self.fleet_max_workers = fleet_max_workers
+        self.fleet_interval_s = float(fleet_interval_s)
+        self.fleet_scaler: Optional[FleetScaler] = None
+        self._retired_ranks: set = set()
+        self._grace_until: Dict[int, float] = {}
 
         # resume coordinates, parsed from the worker argv (the trainer's
         # epoch checkpoints land at <checkpoint_dir>/<train_method>.ckpt).
@@ -437,7 +643,16 @@ class ElasticSupervisor:
         # compiling identical tiny-model entries race a shared cache dir
         # (same reason tests/test_multiprocess.py splits per rank)
         prefix = env.pop("DPT_XLA_CACHE_PREFIX", None)
-        if prefix:
+        if env.get("DPT_AOT_CACHE"):
+            # A worker that persists executables to the shared AOT store
+            # must NOT also use a persistent XLA compilation cache: an
+            # executable rehydrated from that cache serializes WITHOUT
+            # its backend kernel symbols, so the store entry it produces
+            # is refused ("Symbols not found") by every sibling that
+            # tries to load it. The store supersedes the XLA cache here —
+            # it persists exactly what the cache would have, fleet-wide.
+            env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        elif prefix:
             env["JAX_COMPILATION_CACHE_DIR"] = f"{prefix}_rank{rank}"
         return env
 
@@ -620,6 +835,127 @@ class ElasticSupervisor:
             self._teardown()
             raise
 
+    # -- fleet elasticity (serve workload; FleetScaler's actuation) ----------
+    def _worker_host(self) -> str:
+        return _worker_arg(self.worker_args, ("--host",), "127.0.0.1")
+
+    def _routers(self):
+        return [r for r in (self.router, self.standby_router)
+                if r is not None]
+
+    def active_serve_ranks(self) -> List[int]:
+        """Rank slots currently meant to be serving (spawned and not
+        deliberately retired)."""
+        return [r for r in range(len(self._procs))
+                if r not in self._retired_ranks]
+
+    def spawn_fleet_worker(self) -> Optional[int]:
+        """Grow the fleet by ONE worker: reuse the lowest retired rank
+        slot (its port base+R and heartbeat slot come back with it) or
+        append a fresh rank. Rides the same machinery as a per-rank
+        relaunch — attempt-0 beat/timeline dirs, the fleet-shared
+        ``$DPT_AOT_CACHE`` (the newcomer loads the executables its
+        siblings compiled: ``recompiles: 0``) — then waits for
+        ``/healthz`` ready and admits the worker to every router.
+        Returns the rank, or None if the spawn failed."""
+        if self._retired_ranks:
+            rank = min(self._retired_ranks)
+        else:
+            rank = len(self._procs)
+        logger.info("elastic fleet: spawning worker %d (port %d)",
+                    rank, self.base_port + rank)
+        log_f = open(self._log_path(0, rank), "ab")
+        self._log_files.append(log_f)
+        world = max(len(self._procs), rank + 1)
+        try:
+            proc = subprocess.Popen(
+                # attempt index 1: chaos specs are armed on attempt 0
+                # argv only — a spawned newcomer must not re-fire them
+                self._worker_argv(1, rank, hb_attempt=0),
+                env=self._worker_env(rank, world, _free_port(), 0),
+                cwd=self.cwd,
+                stdout=log_f,
+                stderr=subprocess.STDOUT,
+            )
+        except Exception:  # noqa: BLE001 — a failed grow must not kill
+            # the fleet that exists
+            logger.exception("elastic fleet: spawn of worker %d failed",
+                             rank)
+            return None
+        if rank < len(self._procs):
+            self._procs[rank] = proc
+        else:
+            self._procs.append(proc)
+        self._retired_ranks.discard(rank)
+        self._grace_until[rank] = time.time() + max(
+            self.spawn_timeout_s, self.heartbeat_timeout_s
+        )
+        host = self._worker_host()
+        if self._wait_worker_ready(rank):
+            for router in self._routers():
+                router.ensure_worker(host, self.base_port + rank)
+        else:
+            # admit unhealthy: the routers' own probes readmit the
+            # moment /healthz answers (slow model load, not a failure)
+            for router in self._routers():
+                router.ensure_worker(host, self.base_port + rank,
+                                     healthy=False)
+        obsm.ELASTIC_WORLD_SIZE.set(len(self.active_serve_ranks()))
+        return rank
+
+    def _wait_worker_ready(self, rank: int,
+                           timeout_s: Optional[float] = None) -> bool:
+        import urllib.request
+
+        url = (f"http://{self._worker_host()}:{self.base_port + rank}"
+               "/healthz")
+        deadline = time.monotonic() + (
+            timeout_s if timeout_s is not None else self.spawn_timeout_s
+        )
+        while time.monotonic() < deadline:
+            try:
+                with urllib.request.urlopen(url, timeout=2.0) as resp:
+                    if resp.status == 200:
+                        return True
+            except Exception:  # noqa: BLE001 — still booting
+                pass
+            if self._shutdown.wait(0.1):
+                return False
+        return False
+
+    def retire_fleet_worker(self) -> Optional[int]:
+        """Shrink the fleet by ONE worker: the highest active rank.
+        Order matters — eject from every router FIRST (no new
+        placements), wait out router-tracked in-flight requests, THEN
+        SIGTERM (serve/cli.py drains its own queue on it), grace,
+        SIGKILL stragglers. Returns the rank, or None if there is
+        nothing retireable."""
+        active = self.active_serve_ranks()
+        if len(active) <= 1:
+            return None
+        rank = max(active)
+        address = f"{self._worker_host()}:{self.base_port + rank}"
+        logger.info("elastic fleet: retiring worker %d (%s)",
+                    rank, address)
+        for router in self._routers():
+            router.retire_worker(
+                address, drain_timeout_s=self.teardown_grace_s)
+        self._retired_ranks.add(rank)
+        proc = self._procs[rank]
+        if proc.poll() is None:
+            try:
+                proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            deadline = time.monotonic() + self.teardown_grace_s
+            while time.monotonic() < deadline and proc.poll() is None:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()
+        proc.wait()
+        obsm.ELASTIC_WORLD_SIZE.set(len(self.active_serve_ranks()))
+        return rank
+
     def request_stop(self) -> None:
         """Ask a running supervision loop to stop cleanly: tear down the
         workers and return 0 with ``final: stopped``. The serve
@@ -773,20 +1109,30 @@ class ElasticSupervisor:
         metrics_server = None
         fleet_scraper = None
         router_httpd = None
+        standby_httpd = None
         if self.workload == "serve" and self.router_port is not None:
             # the front door: one address, load-aware placement over
             # worker ports base+R, transparent retry of sheds and
             # SIGKILLed workers (a relaunching worker is a retried
-            # sibling, not a client-visible failure)
+            # sibling, not a client-visible failure). With
+            # --router-standby-port, TWO instances run as an
+            # active/standby HA pair: both proxy, the standby pulls the
+            # active's /admin/state snapshot each probe interval and
+            # takes over on the first missed probe — the front door's
+            # own death is a client retry to the second address, never
+            # an outage.
             from distributedpytorch_tpu.serve.router import (
                 Router,
                 make_router_http,
             )
 
-            host = _worker_arg(self.worker_args, ("--host",), "127.0.0.1")
-            self.router = Router(
-                [(host, self.base_port + r) for r in range(self.nprocs)]
-            ).start()
+            host = self._worker_host()
+            workers = [(host, self.base_port + r)
+                       for r in range(self.nprocs)]
+            peer = ((host, self.router_standby_port)
+                    if self.router_standby_port is not None else None)
+            self.router = Router(workers, role="active",
+                                 peer=peer).start()
             router_httpd = make_router_http(
                 self.router, host=host, port=self.router_port,
             )
@@ -794,11 +1140,37 @@ class ElasticSupervisor:
                 target=router_httpd.serve_forever, daemon=True,
                 name="dpt-router-http",
             ).start()
+            if self.router_standby_port is not None:
+                self.standby_router = Router(
+                    workers, role="standby",
+                    peer=(host, self.router_port),
+                ).start()
+                standby_httpd = make_router_http(
+                    self.standby_router, host=host,
+                    port=self.router_standby_port,
+                )
+                threading.Thread(
+                    target=standby_httpd.serve_forever, daemon=True,
+                    name="dpt-router-standby-http",
+                ).start()
             logger.info(
-                "elastic: router front door on http://%s:%d over %d "
+                "elastic: router front door on http://%s:%d%s over %d "
                 "worker(s) — POST /predict, POST /admin/ab, GET /stats",
-                host, router_httpd.server_address[1], self.nprocs,
+                host, router_httpd.server_address[1],
+                (f" (+ standby on :{self.router_standby_port})"
+                 if standby_httpd is not None else ""),
+                self.nprocs,
             )
+        if self.workload == "serve" and (
+                self.fleet_plan is not None
+                or self.fleet_max_workers is not None):
+            self.fleet_scaler = FleetScaler(
+                self, plan=self.fleet_plan,
+                min_workers=self.fleet_min_workers,
+                max_workers=self.fleet_max_workers,
+            )
+            if self.fleet_interval_s > 0:
+                self.fleet_scaler.start(self.fleet_interval_s)
         if self.metrics_port is not None:
             from distributedpytorch_tpu.obs.http import start_metrics_server
 
@@ -815,15 +1187,25 @@ class ElasticSupervisor:
 
                 host = _worker_arg(self.worker_args, ("--host",),
                                    "127.0.0.1")
+                def _fan_sweep(seen):
+                    # BOTH routers place off the same per-worker
+                    # numbers: the standby's placement state is
+                    # reconstructed from this sweep, not from the
+                    # active — part of why failover is stateless
+                    for router in self._routers():
+                        router.ingest_fleet_metrics(seen)
+
                 fleet_scraper = FleetMetricsScraper(
                     host, self.base_port,
-                    lambda: (self.world_history[-1]
-                             if self.world_history else self.nprocs),
+                    # dynamic: the fleet scaler may have grown the
+                    # world past nprocs (retired ranks scrape as dead
+                    # and drop out of the pane, which is correct)
+                    lambda: (len(self._procs) if self._procs
+                             else self.nprocs),
                     # the router places off the SAME per-worker numbers
                     # this pane collects: each sweep feeds it queue
                     # depths (and marks non-answering workers stale)
-                    on_sweep=(self.router.ingest_fleet_metrics
-                              if self.router is not None else None),
+                    on_sweep=(_fan_sweep if self._routers() else None),
                 ).start()
                 self.fleet_scraper = fleet_scraper
 
@@ -853,12 +1235,18 @@ class ElasticSupervisor:
             self._write_report(final="stopped")
             return 0
         finally:
+            if self.fleet_scaler is not None:
+                self.fleet_scaler.stop()
             if fleet_scraper is not None:
                 fleet_scraper.stop()
             if router_httpd is not None:
                 router_httpd.shutdown()
+            if standby_httpd is not None:
+                standby_httpd.shutdown()
             if self.router is not None:
                 self.router.stop()
+            if self.standby_router is not None:
+                self.standby_router.stop()
             if metrics_server is not None:
                 metrics_server.close()
 
@@ -871,8 +1259,9 @@ class ElasticSupervisor:
         cannot be healed per rank. The restart budget counts relaunch
         WAVES (one wave may replace several workers), and the attempt
         ledger records one failed entry per wave so reports read the
-        same as training's. The world never shrinks here — serve
-        capacity is the replica scaler's lever, not the supervisor's."""
+        same as training's. The world only changes DELIBERATELY here —
+        through the fleet scaler's spawn/retire (a retired rank's death
+        is the plan, not a failure); unplanned deaths are relaunches."""
         world = self.nprocs
         attempt = 0
         self.world_history.append(world)
@@ -880,10 +1269,15 @@ class ElasticSupervisor:
         t0 = time.monotonic()
         self._spawn(0, world)
         started_at = time.time()
-        # a just-relaunched worker's stale beat (or missing beat while
-        # it re-warms off the AOT store) must not read as a new death
-        grace_until: Dict[int, float] = {}
+        # a just-relaunched/spawned worker's stale beat (or missing
+        # beat while it re-warms off the AOT store) must not read as a
+        # new death; shared with spawn_fleet_worker, hence an attribute
+        grace_until = self._grace_until
         while True:
+            # the fleet scaler may have grown/shrunk the world
+            if len(self._procs) != world:
+                world = len(self._procs)
+                self.world_history.append(world)
             if self._shutdown.is_set():
                 codes = self._exit_codes()
                 self._teardown()
@@ -904,6 +1298,8 @@ class ElasticSupervisor:
             now = time.time()
             failed: Dict[int, health.RankHealth] = {}
             for r in range(world):
+                if r in self._retired_ranks:
+                    continue  # dead by design — the scaler retired it
                 alive = codes.get(r) is None
                 if alive and now < grace_until.get(r, 0.0):
                     continue
@@ -1171,6 +1567,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "across the workers with load-aware placement, "
                          "transparent retry of 503s and dead workers, "
                          "and POST /admin/ab fan-out (serve/router.py)")
+    ap.add_argument("--router-standby-port", type=int, default=None,
+                    help="With --router-port: run a SECOND router as an "
+                         "active/standby HA pair on this port. Both "
+                         "proxy /predict; the standby pulls the "
+                         "active's /admin/state snapshot every probe "
+                         "interval and takes over on the first missed "
+                         "probe — clients keep both addresses and fail "
+                         "over on connection refusal (no VIP; "
+                         "docs/SERVING.md 'Front door HA')")
+    ap.add_argument("--fleet-plan", type=str, default=None,
+                    help="dpt_serve_plan JSON for the FLEET scaler: the "
+                         "supervisor spawns/retires whole serve workers "
+                         "to match the plan's replica recommendation "
+                         "for the observed arrival rate, every decision "
+                         "citing its plan-serve grid point")
+    ap.add_argument("--fleet-min-workers", type=int, default=1,
+                    help="Fleet scaler floor (never retire below)")
+    ap.add_argument("--fleet-max-workers", type=int, default=None,
+                    help="Fleet scaler ceiling; setting it (or "
+                         "--fleet-plan) enables the fleet scaler")
+    ap.add_argument("--fleet-interval", type=float, default=10.0,
+                    help="Fleet scaler control-window cadence (s); "
+                         "<= 0 leaves the scaler manual (tests/ops "
+                         "drive .step() directly)")
     ap.add_argument("worker_args", nargs=argparse.REMAINDER,
                     help="Training CLI args (prefix with --)")
     args = ap.parse_args(argv)
@@ -1202,6 +1622,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         metrics_port=args.metrics_port,
         workload=args.workload,
         router_port=args.router_port,
+        router_standby_port=args.router_standby_port,
+        fleet_plan=args.fleet_plan,
+        fleet_min_workers=args.fleet_min_workers,
+        fleet_max_workers=args.fleet_max_workers,
+        fleet_interval_s=args.fleet_interval,
     )
     return sup.run()
 

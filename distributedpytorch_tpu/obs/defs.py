@@ -187,6 +187,12 @@ ROUTER_WORKER_EVENTS = REGISTRY.counter(
     "/healthz readiness, stale on a missed stats scrape)", ("event",))
 ROUTER_HEALTHY_WORKERS = REGISTRY.gauge(
     "dpt_router_healthy_workers", "Workers currently in the routable pool")
+ROUTER_HA_EVENTS = REGISTRY.counter(
+    "dpt_router_ha_events_total",
+    "Active/standby pair transitions (takeover = standby promoted "
+    "itself after the active missed a probe, demote = a router yielded "
+    "the active role to a higher-epoch peer, sync = standby imported "
+    "the active's /admin/state snapshot)", ("event",))
 
 # -- elastic supervisor (recorded by dist/elastic.py; jax-free) -------------
 ELASTIC_RESTARTS = REGISTRY.counter(
@@ -199,6 +205,12 @@ ELASTIC_RANK_FAILURES = REGISTRY.counter(
 ELASTIC_ATTEMPTS = REGISTRY.counter(
     "dpt_elastic_attempts_total", "Launch attempts by outcome",
     ("outcome",))
+FLEET_SCALE_EVENTS = REGISTRY.counter(
+    "dpt_fleet_scale_events_total",
+    "Supervisor-level fleet actuations: whole serve workers spawned or "
+    "retired (dist/elastic.py FleetScaler), each citing the plan-serve "
+    "grid point it executes — the process-level sibling of "
+    "dpt_serve_scale_events_total", ("direction",))
 
 # -- obs itself -------------------------------------------------------------
 FLIGHT_DUMPS = REGISTRY.counter(

@@ -667,6 +667,17 @@ def main(argv=None) -> int:
     threading.Thread(  # Ctrl-C must interrupt serve_forever, not a join
         target=httpd.serve_forever, daemon=True,
     ).start()
+    # SIGTERM is the fleet scaler's retire signal (dist/elastic.py
+    # retire_fleet_worker: routers drain first, then SIGTERM): exit the
+    # wait loop and drain the queue in the finally — a retire must
+    # finish the work it already admitted, same as Ctrl-C
+    import signal as _signal
+
+    sigterm = threading.Event()
+    try:
+        _signal.signal(_signal.SIGTERM, lambda *_: sigterm.set())
+    except ValueError:
+        pass  # not the main thread (embedded in a test harness)
     rc = 0
     try:
         # wake periodically: a server whose in-process restart budget is
@@ -675,10 +686,13 @@ def main(argv=None) -> int:
         from distributedpytorch_tpu.serve.server import STATE_STOPPED
 
         while server.state != STATE_STOPPED:
-            threading.Event().wait(0.5)
-        logger.error("serve worker terminal (dispatch-core restart "
-                     "budget spent) — exiting for relaunch")
-        rc = 1
+            if sigterm.wait(0.5):
+                logger.info("SIGTERM: retiring (draining queue)")
+                break
+        else:
+            logger.error("serve worker terminal (dispatch-core restart "
+                         "budget spent) — exiting for relaunch")
+            rc = 1
     except KeyboardInterrupt:
         logger.info("shutting down (draining queue)")
     finally:

@@ -1,0 +1,161 @@
+"""The scaling control law, shared by both capacity actuators.
+
+Two actuators move serve capacity, at different granularities:
+
+* ``serve/scaler.py:ReplicaScaler`` resizes the live replica group
+  *inside* one worker process (device-level: add/retire a replica,
+  AOT-store-backed, no restart);
+* ``dist/elastic.py:FleetScaler`` spawns/retires *whole serve
+  workers* from the supervisor (process-level: the loop plan-serve
+  actually sizes).
+
+Both make the SAME kind of decision — "the observed load says run N
+units; I run M" — and both must cite the ``dpt_serve_plan`` grid point
+their decision executes. This module is that one control law, extracted
+so the two actuators cannot drift: the decision record
+(:class:`ScaleDecision`), the pure decide step (:func:`decide_scale` —
+clamp, pin-hold, cooldown, direction), and the plan citation
+(:func:`plan_point_for` — observed rate → nearest simulated poisson
+scenario at or above it → grid point key at the base knobs).
+
+Deliberately jax-free: the fleet actuator runs inside the supervisor
+process, which never initializes a device runtime. Anything that needs
+a backend (the replica scaler's default device cap) stays in the
+caller.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+DIR_UP = "up"
+DIR_DOWN = "down"
+DIR_HOLD = "hold"
+
+
+@dataclasses.dataclass
+class ScaleDecision:
+    """One control-loop verdict: what to do, and which plan point says
+    it's the right thing to do."""
+
+    direction: str              # up | down | hold
+    current: int
+    target: int
+    reason: str
+    plan_point: Optional[str] = None    # grid point key this executes
+    plan_replicas: Optional[int] = None  # the plan's own recommendation
+    rate_rps: Optional[float] = None    # observed rate matched to the plan
+
+    def payload(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def plan_point_for(plan: Optional[dict], target: int,
+                   rate_rps: Optional[float],
+                   ) -> Tuple[Optional[str], Optional[int]]:
+    """Cite the plan: the grid point key at the base knobs whose
+    (scenario, replicas) matches what a decision executes, plus the
+    scenario's own recommended replica count. The scenario is the
+    nearest simulated poisson rate at or above the observed arrival
+    rate (the conservative match: plan for at least the load you see);
+    with no observed rate, the scenario whose recommendation equals the
+    target."""
+    if not plan:
+        return None, None
+    scenarios = [s for s in plan.get("scenarios", [])
+                 if s.get("kind") == "poisson"
+                 and s.get("rate_rps") is not None]
+    recs = plan.get("recommendations", [])
+    label = None
+    if scenarios and rate_rps is not None:
+        geq = [s for s in scenarios
+               if float(s["rate_rps"]) >= float(rate_rps) - 1e-9]
+        pick = (min(geq, key=lambda s: float(s["rate_rps"])) if geq
+                else max(scenarios, key=lambda s: float(s["rate_rps"])))
+        label = pick["label"]
+    elif recs:
+        for rec in recs:
+            if rec.get("replicas") == target:
+                label = rec["scenario"]
+                break
+        if label is None:
+            label = recs[0]["scenario"]
+    if label is None:
+        return None, None
+    plan_replicas = next(
+        (rec.get("replicas") for rec in recs
+         if rec.get("scenario") == label), None)
+    grid = plan.get("grid", {})
+    base_ladder = (grid.get("bucket_ladders") or [[]])[0]
+    base_eager = (grid.get("eager") or [True])[0]
+    base_cap = (grid.get("queue_caps") or [None])[0]
+    for p in plan.get("points", []):
+        if (p.get("scenario") == label
+                and p.get("replicas") == target
+                and p.get("bucket_sizes") == base_ladder
+                and p.get("eager") == base_eager
+                and p.get("queue_cap_images") == base_cap):
+            return p.get("key"), plan_replicas
+    return None, plan_replicas
+
+
+def plan_recommendation(plan: Optional[dict],
+                        rate_rps: Optional[float]) -> Optional[int]:
+    """The plan's own replica recommendation for the observed rate
+    (nearest poisson scenario at or above it) — what the fleet actuator
+    uses as its recommendation signal, where the in-process scaler has
+    the queue-depth/shed hysteresis hint instead."""
+    if not plan or rate_rps is None:
+        return None
+    scenarios = [s for s in plan.get("scenarios", [])
+                 if s.get("kind") == "poisson"
+                 and s.get("rate_rps") is not None]
+    if not scenarios:
+        return None
+    geq = [s for s in scenarios
+           if float(s["rate_rps"]) >= float(rate_rps) - 1e-9]
+    pick = (min(geq, key=lambda s: float(s["rate_rps"])) if geq
+            else max(scenarios, key=lambda s: float(s["rate_rps"])))
+    return next(
+        (rec.get("replicas") for rec in plan.get("recommendations", [])
+         if rec.get("scenario") == pick["label"]), None)
+
+
+def decide_scale(
+    current: int,
+    recommendation: Optional[int],
+    *,
+    min_units: int,
+    max_units: int,
+    windows_since_action: int,
+    cooldown_windows: int,
+    hold_reason: Optional[str] = None,
+    rate_rps: Optional[float] = None,
+    plan: Optional[dict] = None,
+) -> ScaleDecision:
+    """The pure decide step both actuators share: no actuation, no
+    counters. ``hold_reason`` is the caller's pin (a sustained A/B, a
+    rollout in flight) — non-None holds unconditionally."""
+    if recommendation is None:
+        return ScaleDecision(DIR_HOLD, current, current,
+                             "no hint observed yet")
+    if hold_reason is not None:
+        return ScaleDecision(DIR_HOLD, current, current, hold_reason)
+    target = min(max(int(recommendation), int(min_units)), int(max_units))
+    plan_point, plan_replicas = plan_point_for(plan, target, rate_rps)
+    if target == current:
+        return ScaleDecision(DIR_HOLD, current, current,
+                             "hint matches live replica count",
+                             plan_point, plan_replicas, rate_rps)
+    if windows_since_action < cooldown_windows:
+        return ScaleDecision(
+            DIR_HOLD, current, current,
+            f"cooldown ({windows_since_action}/"
+            f"{cooldown_windows} windows since last action)",
+            plan_point, plan_replicas, rate_rps)
+    direction = DIR_UP if target > current else DIR_DOWN
+    return ScaleDecision(
+        direction, current, target,
+        f"hint {recommendation} vs live {current}",
+        plan_point, plan_replicas, rate_rps)

@@ -202,7 +202,22 @@ class ServeEngine:
         """The engine's ONLY compile site — store hits never reach it,
         which is what the compile-count spy tests pin."""
         self.aot_compiles += 1
-        return jitted.lower(vars_dev, x_sds).compile()
+        if self.aot_store is None:
+            return jitted.lower(vars_dev, x_sds).compile()
+        # The result is about to be persisted to the AOT store — and an
+        # executable rehydrated from the persistent XLA compilation
+        # cache serializes WITHOUT its backend kernel symbols, so the
+        # store entry would be refused ("Symbols not found") by every
+        # sibling process that tries to load it. Codegen fresh: the AOT
+        # store replaces exactly what the XLA cache would have saved.
+        import jax
+
+        prev = jax.config.jax_enable_compilation_cache
+        jax.config.update("jax_enable_compilation_cache", False)
+        try:
+            return jitted.lower(vars_dev, x_sds).compile()
+        finally:
+            jax.config.update("jax_enable_compilation_cache", prev)
 
     def _entry_key(self, bucket: int, device) -> Tuple[str, dict]:
         """Store key for one bucket executable on one device. The

@@ -368,6 +368,66 @@ def ab_arm_for(request_id: str, split: float) -> str:
     return AB_ARM_B if (h / 2.0 ** 32) < float(split) else AB_ARM_A
 
 
+def merge_fleet_verdict(per_worker: Dict[str, dict]) -> dict:
+    """Fold each worker's ``/admin/ab`` verdict into ONE fleet verdict
+    (the router's ``{"action": "verdict"}`` fan-in).
+
+    Deterministic given the per-worker payloads: workers merge in
+    sorted-address order, counters sum exactly, and every number keeps
+    its provenance — per-arm p99 is reported worst-of-fleet alongside
+    the per-worker values it came from.
+
+    The Dice term is the subtle one: a worker with no pinned probe rows
+    reports ``inter_arm_dice: null`` (no evidence), and the fleet mean
+    averages ONLY workers that produced a value — excluded addresses
+    are named, never silently zero-averaged (a 0.0 would claim the arms
+    fully disagree on a worker that never compared them).
+    """
+    arms: Dict[str, dict] = {}
+    dice_by_worker: Dict[str, Optional[float]] = {}
+    merged: List[str] = []
+    unmergeable: List[str] = []
+    for addr in sorted(per_worker):
+        verdict = per_worker[addr]
+        if not isinstance(verdict, dict) or "arms" not in verdict:
+            unmergeable.append(addr)
+            continue
+        merged.append(addr)
+        dice_by_worker[addr] = verdict.get("inter_arm_dice")
+        for arm, row in sorted(verdict.get("arms", {}).items()):
+            agg = arms.setdefault(arm, {
+                "requests_ok": 0, "requests_failed": 0,
+                "images_ok": 0, "rejected": 0,
+                "weights_versions": [],
+                "p99_ms": None, "p99_ms_by_worker": {},
+            })
+            for key in ("requests_ok", "requests_failed",
+                        "images_ok", "rejected"):
+                agg[key] += int(row.get(key) or 0)
+            version = row.get("weights_version")
+            if version is not None and version not in agg[
+                    "weights_versions"]:
+                agg["weights_versions"].append(version)
+            p99 = row.get("p99_ms")
+            if p99 is not None:
+                agg["p99_ms_by_worker"][addr] = p99
+                agg["p99_ms"] = (p99 if agg["p99_ms"] is None
+                                 else max(agg["p99_ms"], p99))
+    dice_vals = [d for d in dice_by_worker.values() if d is not None]
+    return {
+        "workers": merged,
+        "unmergeable": unmergeable,
+        "arms": arms,
+        "dice": {
+            "fleet_mean": (round(sum(dice_vals) / len(dice_vals), 4)
+                           if dice_vals else None),
+            "per_worker": dice_by_worker,
+            "excluded": sorted(addr for addr, d in dice_by_worker.items()
+                               if d is None),
+        },
+    }
+
+
 class ABTest:
     """Sustained weight A/B over disjoint replica groups.
 
@@ -510,6 +570,13 @@ class ABTest:
             out["inter_arm_dice"] = round(float(np.mean([
                 mask_dice(ma, mb) for ma, mb in zip(masks_a, masks_b)
             ])), 4)
+        else:
+            # no probe rows pinned on THIS worker → no Dice evidence.
+            # null, never 0.0: a fleet merge averaging in a zero would
+            # read "the arms disagree completely" where the truth is
+            # "this worker has nothing to say" (merge_fleet_verdict
+            # excludes null from the fleet Dice mean).
+            out["inter_arm_dice"] = None
         return out
 
     def stop(self, winner: Optional[str] = None) -> dict:

@@ -28,6 +28,26 @@ tail-tolerance toolkit (Dean & Barroso, "The Tail at Scale", CACM '13):
 * **Eject / readmit** — a connection-dead worker leaves the pool and a
   probe thread re-admits it when its ``/healthz`` answers ready again
   (the supervisor relaunching it is exactly this path).
+* **Active/standby HA** — the router itself must not be the last
+  single point of failure, so the supervisor runs TWO of these
+  (``elastic --router-port P --router-standby-port Q``). Everything a
+  router knows is *reconstructible by construction*: placement state
+  (depths, stale flags) re-derives from the fleet metrics sweep both
+  routers ingest, eject/readmit re-derives from each router's own
+  probes, and the rest (A/B split + per-arm ledger, the hedge
+  deadline's p99 window, the retired set) rides a periodic
+  ``/admin/state`` snapshot the standby pulls from the active. Both
+  routers proxy ``/predict`` at all times — the role only governs who
+  owns mutable state and which way snapshots flow — so the client
+  contract is two addresses and failover on connection refusal
+  (docs/SERVING.md "Front door HA"; no VIP assumed). The standby
+  health-probes the active every probe interval and takes over on the
+  FIRST missed probe; a relaunched ex-active sees the higher takeover
+  epoch and demotes itself to standby.
+
+Fleet elasticity rides the same pool: ``ensure_worker`` admits a
+worker the supervisor's FleetScaler just spawned, ``retire_worker``
+drains one it is about to SIGTERM (unroutable → wait out in-flight).
 
 Sustained A/B (serve/rollout.py:ABTest): the router stamps each
 request's arm (``X-AB-Arm``, from the same deterministic request-id
@@ -56,7 +76,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
 from distributedpytorch_tpu.serve.metrics import percentile
-from distributedpytorch_tpu.serve.rollout import ab_arm_for
+from distributedpytorch_tpu.serve.rollout import (
+    ab_arm_for,
+    merge_fleet_verdict,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +111,7 @@ class WorkerState:
         self.port = int(port)
         self.healthy = True
         self.stale = False          # healthy but not answering scrapes
+        self.retired = False        # deliberately drained out of the pool
         self.inflight = 0           # router-local in-flight requests
         self.depth = 0              # last-scraped queue depth (images)
         self.last_scrape_t: Optional[float] = None
@@ -110,7 +134,8 @@ class WorkerState:
     def payload(self) -> dict:
         return {
             "address": self.address, "healthy": self.healthy,
-            "stale": self.stale, "inflight": self.inflight,
+            "stale": self.stale, "retired": self.retired,
+            "inflight": self.inflight,
             "depth": self.depth,
             "last_shed_reason": self.last_shed_reason,
         }
@@ -135,7 +160,11 @@ class Router:
         stale_penalty: int = 1_000_000,
         seed: int = 0,
         clock=time.monotonic,
+        role: str = "active",
+        peer: Optional[Tuple[str, int]] = None,
     ):
+        if role not in ("active", "standby"):
+            raise ValueError(f"role must be active|standby, not {role!r}")
         if policy not in ("least", "p2c"):
             raise ValueError(f"unknown placement policy {policy!r}")
         self.workers = [
@@ -174,15 +203,26 @@ class Router:
         self.ab_split = 0.5
         self.ab_label = ""
         self._ab_ledger: Dict[str, dict] = {}
+        # active/standby HA: role governs state ownership + snapshot
+        # flow, NOT routing — both roles proxy /predict at all times.
+        # ha_primary remembers which router was BORN active: it wins
+        # epoch ties and promotes first out of a both-standby state.
+        self.role = role
+        self.peer = (peer[0], int(peer[1])) if peer is not None else None
+        self.ha_primary = role == "active"
+        self.ha_epoch = 0
+        self.takeovers = 0
+        self.ha_syncs = 0
+        self._peer_epoch_seen = 0   # highest epoch the peer has shown us
 
     # -- pool management -----------------------------------------------------
     def _healthy(self) -> List[WorkerState]:
-        return [w for w in self.workers if w.healthy]
+        return [w for w in self.workers if w.healthy and not w.retired]
 
     def _pick(self, exclude=()) -> Optional[WorkerState]:
         with self._lock:
             pool = [w for w in self.workers
-                    if w.healthy and w not in exclude]
+                    if w.healthy and not w.retired and w not in exclude]
             if not pool:
                 return None
             if self.policy == "p2c" and len(pool) > 2:
@@ -223,6 +263,51 @@ class Router:
         logger.info("router: readmitted %s (/healthz ready)",
                     worker.address)
 
+    def ensure_worker(self, host: str, port: int,
+                      healthy: bool = True) -> WorkerState:
+        """Admit a worker the fleet actuator just spawned (or un-retire
+        a slot it is reusing). Idempotent by address."""
+        port = int(port)
+        with self._lock:
+            for worker in self.workers:
+                if worker.host == host and worker.port == port:
+                    worker.retired = False
+                    break
+            else:
+                worker = WorkerState(
+                    f"worker{len(self.workers)}", host, port)
+                self.workers.append(worker)
+            worker.healthy = bool(healthy)
+            worker.stale = False
+            worker.ejected_t = None
+            worker.last_shed_reason = None
+        obsm.ROUTER_WORKER_EVENTS.labels(event="admit").inc()
+        obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
+        flight.record("router_worker", event="admit", worker=worker.address)
+        logger.info("router: admitted %s (fleet spawn)", worker.address)
+        return worker
+
+    def retire_worker(self, address: str,
+                      drain_timeout_s: float = 10.0) -> bool:
+        """Drain a worker the fleet actuator is about to SIGTERM: make
+        it unroutable, then wait out its router-local in-flight
+        requests. Returns True once drained (a missing address is
+        trivially drained)."""
+        target = next(
+            (w for w in self.workers if w.address == address), None)
+        if target is None:
+            return True
+        with self._lock:
+            target.retired = True
+        obsm.ROUTER_WORKER_EVENTS.labels(event="retire").inc()
+        obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
+        flight.record("router_worker", event="retire", worker=address)
+        logger.info("router: retiring %s (fleet drain)", address)
+        deadline = time.monotonic() + float(drain_timeout_s)
+        while target.inflight > 0 and time.monotonic() < deadline:
+            self._stop.wait(0.02)
+        return target.inflight == 0
+
     def ingest_fleet_metrics(self, expositions: Dict[str, str]) -> None:
         """Feed of the fleet metrics scraper (dist/elastic.py): parse
         each answering worker's queue depth out of its exposition text;
@@ -230,6 +315,8 @@ class Router:
         as pressure until it answers again."""
         now = self.clock()
         for i, worker in enumerate(self.workers):
+            if worker.retired:  # deliberately gone — silence is expected
+                continue
             text = expositions.get(str(i))
             if text is None:
                 if worker.healthy and not worker.stale:
@@ -511,6 +598,11 @@ class Router:
             "router": self.ab_status(),
             "workers": per_worker,
         }
+        if action == "verdict":
+            # one fleet verdict: per-arm ledgers summed across workers,
+            # Dice averaged over workers that actually served probe
+            # rows (serve/rollout.py:merge_fleet_verdict)
+            body["fleet"] = merge_fleet_verdict(per_worker)
         return (200 if ok else 502), body
 
     def ab_status(self) -> dict:
@@ -537,6 +629,8 @@ class Router:
         depth (and mark the silent ones stale)."""
         now = self.clock()
         for worker in self.workers:
+            if worker.retired:
+                continue
             if not worker.healthy:
                 result = self._send(worker, "GET", "/healthz",
                                     timeout=2.0)
@@ -560,6 +654,148 @@ class Router:
             worker.stale = False
             worker.last_scrape_t = now
 
+    # -- active/standby HA ---------------------------------------------------
+    def export_state(self) -> dict:
+        """The ``/admin/state`` snapshot: everything a sibling router
+        cannot re-derive from its own probes + the fleet metrics sweep
+        — the A/B split and per-arm ledger, the hedge deadline's
+        latency window, and the retired set. Worker rows ride along as
+        a hint (the importer's own probes remain authoritative)."""
+        with self._lock:
+            ledger = {
+                arm: {
+                    "requests_ok": led["requests_ok"],
+                    "requests_failed": led["requests_failed"],
+                    "latencies_s": [round(v, 6) for v in
+                                    list(led["latencies_s"])[-512:]],
+                }
+                for arm, led in self._ab_ledger.items()
+            }
+            latencies = [round(v, 6) for v in
+                         list(self._latencies_s)[-512:]]
+        return {
+            "kind": "dpt_router_state",
+            "role": self.role,
+            "epoch": self.ha_epoch,
+            "primary": self.ha_primary,
+            "policy": self.policy,
+            "workers": [w.payload() for w in self.workers],
+            "ab": {"active": self.ab_active, "split": self.ab_split,
+                   "label": self.ab_label, "ledger": ledger},
+            "latencies_s": latencies,
+        }
+
+    def import_state(self, state: dict) -> None:
+        """Apply a peer's snapshot (standby side of the exchange):
+        restore the A/B config + ledger and the latency window, adopt
+        the retired set, and admit workers the peer knows that we were
+        not constructed with (a fleet spawn we missed)."""
+        if state.get("kind") != "dpt_router_state":
+            raise ValueError("not a dpt_router_state snapshot")
+        by_address = {w.address: w for w in self.workers}
+        for row in state.get("workers", []):
+            addr = row.get("address", "")
+            worker = by_address.get(addr)
+            if worker is None and ":" in addr:
+                host, _, port = addr.rpartition(":")
+                worker = self.ensure_worker(
+                    host, int(port), healthy=bool(row.get("healthy")))
+            if worker is not None:
+                worker.retired = bool(row.get("retired", False))
+        ab = state.get("ab", {})
+        with self._lock:
+            self.ab_active = bool(ab.get("active", False))
+            self.ab_split = float(ab.get("split", 0.5))
+            self.ab_label = str(ab.get("label", ""))
+            self._ab_ledger = {
+                arm: {
+                    "requests_ok": int(led.get("requests_ok", 0)),
+                    "requests_failed": int(led.get("requests_failed", 0)),
+                    "latencies_s": collections.deque(
+                        led.get("latencies_s", []), maxlen=4096),
+                }
+                for arm, led in ab.get("ledger", {}).items()
+            }
+            self._latencies_s = collections.deque(
+                state.get("latencies_s", []), maxlen=4096)
+        self.ha_syncs += 1
+        obsm.ROUTER_HA_EVENTS.labels(event="sync").inc()
+
+    def _peer_state(self):
+        """GET the peer router's ``/admin/state``; None if the peer is
+        unreachable or not answering sensibly."""
+        if self.peer is None:
+            return None
+        host, port = self.peer
+        conn = http.client.HTTPConnection(host, port, timeout=2.0)
+        try:
+            conn.request("GET", "/admin/state")
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                return None
+            return json.loads(data)
+        except Exception:  # noqa: BLE001 — unreachable peer is the
+            # signal, not an error
+            return None
+        finally:
+            conn.close()
+
+    def _take_over(self, reason: str) -> None:
+        self.role = "active"
+        self.ha_epoch = max(self.ha_epoch, self._peer_epoch_seen) + 1
+        self.takeovers += 1
+        obsm.ROUTER_HA_EVENTS.labels(event="takeover").inc()
+        flight.record("router_ha", event="takeover", reason=reason,
+                      epoch=self.ha_epoch)
+        logger.warning("router: TOOK OVER as active (epoch %d): %s",
+                       self.ha_epoch, reason)
+
+    def _demote(self, peer_epoch: int, reason: str) -> None:
+        self.role = "standby"
+        self.ha_epoch = max(self.ha_epoch, int(peer_epoch))
+        obsm.ROUTER_HA_EVENTS.labels(event="demote").inc()
+        flight.record("router_ha", event="demote", reason=reason,
+                      epoch=self.ha_epoch)
+        logger.warning("router: demoted to standby (epoch %d): %s",
+                       self.ha_epoch, reason)
+
+    def ha_once(self) -> None:
+        """One HA exchange with the peer router (runs every probe
+        interval, so 'takeover within one probe interval' is by
+        construction). Standby + reachable active → pull its snapshot.
+        Standby + dead active → take over on THIS missed probe. Both
+        active (a relaunched ex-active rejoining) → the higher epoch
+        keeps the role, primary wins ties. Both standby → the primary
+        promotes itself."""
+        if self.peer is None:
+            return
+        state = self._peer_state()
+        if state is None:
+            if self.role == "standby":
+                self._take_over("active router missed a probe")
+            return
+        peer_role = state.get("role", "")
+        peer_epoch = int(state.get("epoch", 0))
+        self._peer_epoch_seen = max(self._peer_epoch_seen, peer_epoch)
+        if self.role == "active" and peer_role == "active":
+            if peer_epoch > self.ha_epoch or (
+                    peer_epoch == self.ha_epoch and not self.ha_primary):
+                self._demote(peer_epoch,
+                             "peer is active at a higher epoch")
+            return
+        if self.role == "standby" and peer_role == "standby":
+            if self.ha_primary:
+                self._take_over("both routers standby; primary promotes")
+            return
+        if self.role == "standby":
+            try:
+                self.import_state(state)
+            except Exception:  # noqa: BLE001 — a malformed snapshot
+                # must not kill the probe loop; next interval retries
+                logger.exception("router: peer snapshot import failed")
+            self.ha_epoch = max(self.ha_epoch, peer_epoch)
+
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):
             try:
@@ -567,6 +803,10 @@ class Router:
             except Exception:  # noqa: BLE001 — the probe must outlive
                 # one bad sweep
                 logger.exception("router: probe sweep failed")
+            try:
+                self.ha_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("router: HA exchange failed")
 
     def start(self) -> "Router":
         obsm.ROUTER_HEALTHY_WORKERS.set(len(self._healthy()))
@@ -596,6 +836,15 @@ class Router:
             "p50_ms": round(percentile(lat, 50) * 1e3, 3) if lat else None,
             "p99_ms": round(percentile(lat, 99) * 1e3, 3) if lat else None,
             "ab": self.ab_status(),
+            "ha": {
+                "role": self.role,
+                "epoch": self.ha_epoch,
+                "primary": self.ha_primary,
+                "peer": (f"{self.peer[0]}:{self.peer[1]}"
+                         if self.peer else None),
+                "takeovers": self.takeovers,
+                "syncs": self.ha_syncs,
+            },
         }
 
 
@@ -636,6 +885,8 @@ def make_router_http(router: Router, host: str = "127.0.0.1",
                 })
             elif self.path == "/livez":
                 self._json(200, {"status": "alive"})
+            elif self.path == "/admin/state":
+                self._json(200, router.export_state())
             elif self.path == "/stats":
                 self._json(200, router.stats())
             elif self.path == "/metrics":
@@ -660,6 +911,16 @@ def make_router_http(router: Router, host: str = "127.0.0.1",
                 code, payload = router.admin_ab(spec)
                 self._json(code, payload)
                 return
+            if self.path == "/admin/state":
+                try:
+                    router.import_state(json.loads(body or b"{}"))
+                except (ValueError, TypeError) as exc:
+                    self._json(400, {"error": str(exc)})
+                    return
+                self._json(200, {"imported": True,
+                                 "role": router.role,
+                                 "epoch": router.ha_epoch})
+                return
             if self.path != "/predict":
                 self._json(404, {"error": f"no route {self.path}"})
                 return
@@ -683,3 +944,71 @@ def make_router_http(router: Router, host: str = "127.0.0.1",
             logger.debug("router-http: " + fmt, *fmt_args)
 
     return ThreadingHTTPServer((host, port), Handler)
+
+
+def _parse_hostport(text: str) -> Tuple[str, int]:
+    host, _, port = text.rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """Standalone router process — what the HA chaos drill SIGKILLs.
+    The supervisor normally runs routers in-process; this entry point
+    exists so one half of an active/standby pair can be a real OS
+    process whose death is a real death."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(
+        prog="python -m distributedpytorch_tpu.serve.router",
+        description="Fleet front-door router (one of an HA pair).")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument(
+        "--workers", required=True,
+        help="comma-separated host:port list of serve workers")
+    parser.add_argument("--role", choices=("active", "standby"),
+                        default="active")
+    parser.add_argument(
+        "--peer", default=None,
+        help="host:port of the sibling router's front address")
+    parser.add_argument("--policy", choices=("p2c", "least"),
+                        default="p2c")
+    parser.add_argument("--probe-interval", type=float, default=1.0)
+    parser.add_argument("--retry-budget", type=int, default=3)
+    parser.add_argument("--backoff-base", type=float, default=0.05)
+    parser.add_argument("--hedge", action="store_true")
+    args = parser.parse_args(argv)
+
+    workers = [_parse_hostport(w)
+               for w in args.workers.split(",") if w.strip()]
+    router = Router(
+        workers, policy=args.policy,
+        retry_budget=args.retry_budget,
+        backoff_base_s=args.backoff_base,
+        hedge=args.hedge,
+        probe_interval_s=args.probe_interval,
+        role=args.role,
+        peer=_parse_hostport(args.peer) if args.peer else None,
+    ).start()
+    httpd = make_router_http(router, host=args.host, port=args.port)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    server_thread = threading.Thread(
+        target=httpd.serve_forever, name="dpt-router-http", daemon=True)
+    server_thread.start()
+    logger.info("router: %s on %s:%d (peer=%s, %d workers)",
+                args.role, args.host, args.port, args.peer, len(workers))
+    try:
+        while not stop.wait(0.2):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        router.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -42,28 +42,19 @@ from typing import Callable, List, Optional
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
 
+# the control law itself lives in serve/control.py, shared with the
+# supervisor's FleetScaler (dist/elastic.py) so the two actuation
+# granularities cannot drift; re-exported here for back-compat
+from distributedpytorch_tpu.serve.control import (  # noqa: F401
+    DIR_DOWN,
+    DIR_HOLD,
+    DIR_UP,
+    ScaleDecision,
+    decide_scale,
+    plan_point_for,
+)
+
 logger = logging.getLogger(__name__)
-
-DIR_UP = "up"
-DIR_DOWN = "down"
-DIR_HOLD = "hold"
-
-
-@dataclasses.dataclass
-class ScaleDecision:
-    """One control-loop verdict: what to do, and which plan point says
-    it's the right thing to do."""
-
-    direction: str              # up | down | hold
-    current: int
-    target: int
-    reason: str
-    plan_point: Optional[str] = None    # grid point key this executes
-    plan_replicas: Optional[int] = None  # the plan's own recommendation
-    rate_rps: Optional[float] = None    # observed rate matched to the plan
-
-    def payload(self) -> dict:
-        return dataclasses.asdict(self)
 
 
 class ReplicaScaler:
@@ -124,90 +115,27 @@ class ReplicaScaler:
         """Pure verdict: no actuation, no counters — tests drive this
         directly with a fake hint value and an explicit rate."""
         current = self.server.engine.num_replicas
-        if recommendation is None:
-            return ScaleDecision(DIR_HOLD, current, current,
-                                 "no hint observed yet")
+        hold_reason = None
         abtest = getattr(self.server, "abtest", None)
         if (abtest is not None and abtest.active) or (
                 getattr(self.server, "ab_arms", None) is not None):
-            return ScaleDecision(
-                DIR_HOLD, current, current,
-                "replica groups pinned by a sustained A/B")
-        if self.server.engine.versions_mixed:
-            return ScaleDecision(
-                DIR_HOLD, current, current,
-                "weight versions mixed (rollout in flight)")
+            hold_reason = "replica groups pinned by a sustained A/B"
+        elif self.server.engine.versions_mixed:
+            hold_reason = "weight versions mixed (rollout in flight)"
         cap = self.max_replicas
         if cap is None:
             import jax
             cap = len(jax.devices())
-        target = min(max(int(recommendation), self.min_replicas), cap)
-        plan_point, plan_replicas = self._plan_point(
-            target, observed_rate_rps)
-        if target == current:
-            return ScaleDecision(DIR_HOLD, current, current,
-                                 "hint matches live replica count",
-                                 plan_point, plan_replicas,
-                                 observed_rate_rps)
-        if self.windows_since_action < self.cooldown_windows:
-            return ScaleDecision(
-                DIR_HOLD, current, current,
-                f"cooldown ({self.windows_since_action}/"
-                f"{self.cooldown_windows} windows since last action)",
-                plan_point, plan_replicas, observed_rate_rps)
-        direction = DIR_UP if target > current else DIR_DOWN
-        return ScaleDecision(
-            direction, current, target,
-            f"hint {recommendation} vs live {current}",
-            plan_point, plan_replicas, observed_rate_rps)
+        return decide_scale(
+            current, recommendation,
+            min_units=self.min_replicas, max_units=cap,
+            windows_since_action=self.windows_since_action,
+            cooldown_windows=self.cooldown_windows,
+            hold_reason=hold_reason,
+            rate_rps=observed_rate_rps, plan=self.plan)
 
-    def _plan_point(self, target: int,
-                    rate_rps: Optional[float]):
-        """Cite the plan: the grid point key at the base knobs whose
-        (scenario, replicas) matches what this decision executes, plus
-        the scenario's own recommended replica count. The scenario is
-        the nearest simulated poisson rate at or above the observed
-        arrival rate (the conservative match: plan for at least the
-        load you see); with no observed rate, the scenario whose
-        recommendation equals the target."""
-        plan = self.plan
-        if not plan:
-            return None, None
-        scenarios = [s for s in plan.get("scenarios", [])
-                     if s.get("kind") == "poisson"
-                     and s.get("rate_rps") is not None]
-        recs = plan.get("recommendations", [])
-        label = None
-        if scenarios and rate_rps is not None:
-            geq = [s for s in scenarios
-                   if float(s["rate_rps"]) >= float(rate_rps) - 1e-9]
-            pick = (min(geq, key=lambda s: float(s["rate_rps"])) if geq
-                    else max(scenarios, key=lambda s: float(s["rate_rps"])))
-            label = pick["label"]
-        elif recs:
-            for rec in recs:
-                if rec.get("replicas") == target:
-                    label = rec["scenario"]
-                    break
-            if label is None:
-                label = recs[0]["scenario"]
-        if label is None:
-            return None, None
-        plan_replicas = next(
-            (rec.get("replicas") for rec in recs
-             if rec.get("scenario") == label), None)
-        grid = plan.get("grid", {})
-        base_ladder = (grid.get("bucket_ladders") or [[]])[0]
-        base_eager = (grid.get("eager") or [True])[0]
-        base_cap = (grid.get("queue_caps") or [None])[0]
-        for p in plan.get("points", []):
-            if (p.get("scenario") == label
-                    and p.get("replicas") == target
-                    and p.get("bucket_sizes") == base_ladder
-                    and p.get("eager") == base_eager
-                    and p.get("queue_cap_images") == base_cap):
-                return p.get("key"), plan_replicas
-        return None, plan_replicas
+    def _plan_point(self, target: int, rate_rps: Optional[float]):
+        return plan_point_for(self.plan, target, rate_rps)
 
     # -- actuation -----------------------------------------------------------
     def apply(self, decision: ScaleDecision) -> ScaleDecision:

@@ -161,6 +161,32 @@ class TestColdThenWarm:
         assert any(e.get("result") == "hit" for e in events)
         assert any(e.get("result") == "miss" for e in events)
 
+    def test_persisted_compiles_bypass_xla_compilation_cache(
+        self, pieces, tmp_path, monkeypatch
+    ):
+        """An executable rehydrated from the persistent XLA compilation
+        cache serializes WITHOUT its backend kernel symbols — a sibling
+        process loading the store entry gets "Symbols not found" and
+        recompiles, which silently defeats the whole store. Pin the
+        fix: a compile whose result will be persisted runs with the
+        compilation cache disabled, and the flag is restored after."""
+        import jax
+
+        before = jax.config.jax_enable_compilation_cache
+        calls = []
+        real_update = jax.config.update
+
+        def spy(name, value):
+            if name == "jax_enable_compilation_cache":
+                calls.append(value)
+            real_update(name, value)
+
+        monkeypatch.setattr(jax.config, "update", spy)
+        engine = make_engine(pieces, tmp_path / "store")
+        assert engine.aot_compiles == len(BUCKETS)
+        assert calls and calls[0] is False
+        assert jax.config.jax_enable_compilation_cache == before
+
     def test_counter_family_sees_hits_and_misses(self, pieces, warm):
         from distributedpytorch_tpu.obs import defs as obsm
 

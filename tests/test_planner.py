@@ -351,6 +351,79 @@ class TestPlanFileIO:
 
 
 # ---------------------------------------------------------------------------
+class TestStalePlan:
+    """The dptlint ``stale-plan`` rule: every evaluated plan row carries
+    the ordered-collective fingerprint of the trace its numbers came
+    from, and ``check_plan_staleness`` re-traces and compares — a plan
+    built from a collective program that no longer exists must flag,
+    a fresh plan must not."""
+
+    def test_rows_carry_fingerprints(self, tiny_plan):
+        for row in tiny_plan["points"]:
+            fp = row["jaxpr_fingerprint"]
+            assert isinstance(fp, str) and len(fp) == 16
+            int(fp, 16)  # hex digest prefix
+        # distinct programs → distinct fingerprints (singleGPU traces
+        # zero collectives, MP/gpipe traces the pipeline shifts)
+        assert len({r["jaxpr_fingerprint"]
+                    for r in tiny_plan["points"]}) > 1
+
+    def test_fresh_plan_is_clean(self, tiny_plan):
+        import copy
+
+        # two representative programs (collective-free singleGPU + a
+        # pipeline trace) — every row's stamp is covered by
+        # test_rows_carry_fingerprints, and each re-trace here costs
+        # seconds of tier-1 wall clock
+        subset = copy.deepcopy(tiny_plan)
+        subset["points"] = [tiny_plan["points"][0],
+                            tiny_plan["points"][-1]]
+        assert planner.check_plan_staleness(subset) == []
+
+    def test_drifted_fingerprint_is_flagged(self, tiny_plan):
+        import copy
+
+        drifted = copy.deepcopy(tiny_plan)
+        victim = copy.deepcopy(drifted["points"][1])
+        victim["jaxpr_fingerprint"] = "0" * 16
+        drifted["points"] = [victim]  # one re-trace, one flag
+        findings = planner.check_plan_staleness(drifted)
+        assert len(findings) == 1
+        f = findings[0]
+        assert f.rule == "stale-plan"
+        assert f.layer == "collectives"
+        assert f.where == victim["key"]
+        assert "re-run the planner" in f.message
+
+    def test_fingerprintless_rows_are_skipped(self, tiny_plan):
+        import copy
+
+        legacy = copy.deepcopy(tiny_plan)
+        for row in legacy["points"]:
+            row.pop("jaxpr_fingerprint", None)
+        assert planner.check_plan_staleness(legacy) == []
+
+    def test_untraceable_point_is_flagged(self, tiny_plan):
+        import copy
+
+        row = copy.deepcopy(tiny_plan["points"][0])
+        row["strategy"] = "no_such_strategy_anymore"
+        drifted = copy.deepcopy(tiny_plan)
+        drifted["points"] = [row]  # don't re-trace the healthy rows
+        findings = planner.check_plan_staleness(drifted)
+        ours = [f for f in findings if f.where == row["key"]]
+        assert len(ours) == 1
+        assert ours[0].rule == "stale-plan"
+        assert "no longer traces" in ours[0].message
+
+    def test_analyze_cli_refuses_plan_without_collectives_layer(self):
+        from distributedpytorch_tpu.analysis import cli
+
+        rc = cli.run(["--layer", "lint", "--plan", "whatever.json"])
+        assert rc == cli.EXIT_INFRA
+
+
+# ---------------------------------------------------------------------------
 class TestRankLegs:
     """The bench_multi leg mapping (jax-free): env levers → plan point,
     unmodeled legs absent."""

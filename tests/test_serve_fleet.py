@@ -615,7 +615,8 @@ class TestHTTPFront:
         from distributedpytorch_tpu.serve.cli import make_http_server
 
         httpd = make_http_server(server, port=0)
-        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        threading.Thread(target=lambda: httpd.serve_forever(poll_interval=0.02),
+        daemon=True).start()
         return httpd, httpd.server_address[1]
 
     def test_relaunch_gap_is_503_with_retry_after_and_unready_healthz(
@@ -927,6 +928,11 @@ class TestElasticServeDrill:
         env["DPT_XLA_CACHE_PREFIX"] = (
             f"/tmp/dpt_test_xla_cache_{getpass.getuser()}"
         )
+        # share the suite-wide AOT store (see test_serve_router's
+        # _supervisor_env): relaunch + cold start become loads
+        env["DPT_AOT_CACHE"] = (
+            f"/tmp/dpt_test_aot_store_{getpass.getuser()}"
+        )
         sup = ElasticSupervisor(
             [
                 "-c", "singleGPU",
@@ -1026,6 +1032,13 @@ class TestBenchServeFleetLegs:
         assert router["requests"] > 0
         assert router["zero_client_failures"]
         assert os.path.exists(router["flight_recorder"])
+        hedge = report["hedge"]
+        assert hedge["hedges_fired"] >= 1
+        assert hedge["hedged_p99_improved"]  # hedged p99 < unhedged p99
+        # exactly-once: hedge losers never double-count in the ledger
+        assert hedge["ledger_exact"]
+        assert hedge["unhedged"]["ledger_exact"]
+        assert os.path.exists(hedge["flight_recorder"])
         json.dumps(report)  # still a writable JSON artifact
 
 

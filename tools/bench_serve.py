@@ -51,6 +51,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import sys
 import threading
@@ -678,14 +679,193 @@ def router_leg(engine, args, duration_s: float) -> dict:
     }
 
 
+def hedge_leg(engine, args, duration_s: float) -> dict:
+    """Hedging honesty drill on CPU: the same two-worker stack run
+    twice against a *synthetically wedged* worker — once with hedging
+    off, once with it on — so the hedge's tail-cutting claim is
+    measured against the exact pathology it exists for (a dispatch
+    loop that stops turning while the HTTP front stays healthy, so
+    ejection never triggers). The ``serve_replica_wedge`` fault is
+    re-armed on a cadence with a short self-clearing ``DPT_FAULT_HANG_S``
+    so the slow tail is a sustained *fraction* of traffic (lands in p99
+    at any leg duration), not a single spike. Acceptance: hedged p99 <
+    unhedged p99, at least one hedge actually fired, and the router's
+    ledger counted every hedged request exactly once (ok+failed ==
+    client-side completions — hedge losers never double-count).
+
+    Hedging stays **default-off** in the Router; this leg opts in
+    explicitly. The CPU wedge is an honesty floor, not the promotion
+    gate — chip-window tail measurement (ROADMAP) remains the gate."""
+    import http.client
+    import io
+
+    from PIL import Image
+
+    from distributedpytorch_tpu.obs import flight
+    from distributedpytorch_tpu.serve.cli import make_http_server
+    from distributedpytorch_tpu.serve.router import Router, make_router_http
+    from distributedpytorch_tpu.utils import faults
+
+    engine_b = build_engine(args)
+    server_a = _new_server(engine, args)
+    server_b = _new_server(engine_b, args)
+    httpd_a = make_http_server(server_a, port=0)
+    httpd_b = make_http_server(server_b, port=0)
+    port_a = httpd_a.server_address[1]
+    port_b = httpd_b.server_address[1]
+    for httpd in (httpd_a, httpd_b):
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    img8 = (make_images(1, engine.input_hw, args.seed)[0] * 255.0)
+    buf = io.BytesIO()
+    Image.fromarray(img8.astype(np.uint8)).save(buf, format="PNG")
+    body = buf.getvalue()
+
+    hang_s = 0.5
+    prev_hang = os.environ.get("DPT_FAULT_HANG_S")
+    os.environ["DPT_FAULT_HANG_S"] = str(hang_s)
+    phase_s = max(1.0, duration_s * 0.5)
+
+    def phase(hedge: bool) -> dict:
+        # hedge_factor=1 pins the adaptive delay near p99 instead of
+        # 3x: with the default factor every hedged victim records
+        # ~delay into the latency window and the delay ratchets up to
+        # the hang itself, hiding the win this drill exists to measure
+        router = Router(
+            [("127.0.0.1", port_a), ("127.0.0.1", port_b)],
+            retry_budget=6, backoff_base_s=0.02, backoff_cap_s=0.5,
+            hedge=hedge, hedge_factor=1.0, hedge_floor_ms=40.0,
+            probe_interval_s=0.5,
+        ).start()
+        router_httpd = make_router_http(router, port=0)
+        router_port = router_httpd.server_address[1]
+        threading.Thread(target=router_httpd.serve_forever,
+                         daemon=True).start()
+        latencies: list = []
+        codes: dict = {}
+        transport_errors = 0
+        lock = threading.Lock()
+        stop_at = time.monotonic() + phase_s
+        stop_evt = threading.Event()
+
+        def client() -> None:
+            nonlocal transport_errors
+            while time.monotonic() < stop_at:
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", router_port, timeout=60.0)
+                t0 = time.monotonic()
+                try:
+                    conn.request("POST", "/predict", body=body,
+                                 headers={"Content-Type": "image/png"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    with lock:
+                        codes[resp.status] = codes.get(resp.status, 0) + 1
+                        latencies.append(time.monotonic() - t0)
+                except Exception:  # noqa: BLE001 — client-visible
+                    with lock:
+                        transport_errors += 1
+                finally:
+                    try:
+                        conn.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                time.sleep(0.002)
+
+        def wedger() -> None:
+            # a count-1 wedge stalls exactly ONE dispatch loop for
+            # hang_s; re-arming on a cadence keeps a bounded slow
+            # fraction of traffic for the whole phase (reset first —
+            # install() is idempotent per spec tuple and would keep
+            # the spent count otherwise)
+            while not stop_evt.wait(hang_s * 1.4):
+                faults.reset()
+                faults.install(("serve_replica_wedge",))
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(3)]
+        wedge_thread = threading.Thread(target=wedger, daemon=True)
+        try:
+            faults.install(("serve_replica_wedge",))
+            for t in threads:
+                t.start()
+            wedge_thread.start()
+            for t in threads:
+                t.join(timeout=phase_s + 120.0)
+        finally:
+            stop_evt.set()
+            wedge_thread.join(timeout=5.0)
+            faults.reset()
+            router_httpd.shutdown()
+            router.stop()
+        stats = router.stats()
+        lat = sorted(latencies)
+        p99_ms = (
+            lat[max(0, math.ceil(0.99 * len(lat)) - 1)] * 1e3 if lat
+            else None
+        )
+        completions = sum(codes.values())
+        return {
+            "hedge": hedge,
+            "requests": completions,
+            "codes": {str(code): n for code, n in sorted(codes.items())},
+            "transport_errors": transport_errors,
+            "p99_ms": round(p99_ms, 3) if p99_ms is not None else None,
+            "hedges_fired": stats["hedges_fired"],
+            "hedge_wins": stats["hedge_wins"],
+            "ledger_ok": stats["requests_ok"],
+            "ledger_failed": stats["requests_failed"],
+            # exactly-once: every client completion appears ONCE in the
+            # router's ledger, hedge losers never double-count
+            "ledger_exact": (
+                stats["requests_ok"] + stats["requests_failed"]
+                == completions
+            ),
+        }
+
+    try:
+        unhedged = phase(hedge=False)
+        hedged = phase(hedge=True)
+    finally:
+        faults.reset()
+        if prev_hang is None:
+            os.environ.pop("DPT_FAULT_HANG_S", None)
+        else:
+            os.environ["DPT_FAULT_HANG_S"] = prev_hang
+        artifact = flight.dump("bench_serve_hedge",
+                               path=_flight_path(args, "hedge"))
+        for httpd in (httpd_a, httpd_b):
+            try:
+                httpd.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
+        server_a.stop(drain=True)
+        server_b.stop(drain=True)
+    improved = (
+        unhedged["p99_ms"] is not None and hedged["p99_ms"] is not None
+        and hedged["p99_ms"] < unhedged["p99_ms"]
+    )
+    return {
+        "mode": "hedge",
+        "wedge_hang_s": hang_s,
+        "unhedged": unhedged,
+        "hedged": hedged,
+        "hedged_p99_improved": improved,
+        "ledger_exact": hedged["ledger_exact"],
+        "hedges_fired": hedged["hedges_fired"],
+        "flight_recorder": artifact,
+    }
+
+
 def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None,
               levels: Optional[Sequence[int]] = None) -> dict:
     """The whole program: closed-loop sweep over the concurrency levels,
     one in-SLO open-loop run, one overload run, then the fleet drills —
     a chaos leg (dispatch death → relaunch), a rollout leg (mid-traffic
-    canaried weight swap), and a router leg (two HTTP workers behind the
+    canaried weight swap), a router leg (two HTTP workers behind the
     front-door router, mid-traffic failures, zero client-visible
-    errors). Returns the report dict
+    errors), and a hedge leg (wedged worker, hedged vs unhedged p99,
+    exactly-once ledger). Returns the report dict
     (bench_multi appends it to the session artifact verbatim)."""
     args = args or get_args([])
     levels = [int(c) for c in (levels or args.levels)]
@@ -694,9 +874,9 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
     engine = build_engine(args)
     engine.warmup()
 
-    # budget split: levels + 2 open-loop scenarios + 3 fleet drills,
+    # budget split: levels + 2 open-loop scenarios + 4 fleet drills,
     # capped per-leg
-    legs = len(levels) + 5
+    legs = len(levels) + 6
     leg_s = max(1.0, min(args.duration, (budget_s * 0.8) / legs))
 
     report = {
@@ -741,6 +921,8 @@ def run_bench(budget_s: float = 600.0, args: Optional[argparse.Namespace] = None
     print(json.dumps(report["rollout"]), flush=True)
     report["router"] = router_leg(engine, args, leg_s)
     print(json.dumps(report["router"]), flush=True)
+    report["hedge"] = hedge_leg(engine, args, leg_s)
+    print(json.dumps(report["hedge"]), flush=True)
     report["elapsed_s"] = round(time.monotonic() - t_start, 2)
     report["value"] = capacity  # headline: peak closed-loop imgs/s
     return report
@@ -788,8 +970,9 @@ def main(argv=None) -> int:
     print(text)
     # acceptance: >= 3 levels reported, overload depth bounded, the
     # chaos drill relaunched with zero hung futures, the mid-traffic
-    # rollout promoted with zero 5xx-shaped answers, and the router
-    # drill absorbed both failures with zero client-visible failures
+    # rollout promoted with zero 5xx-shaped answers, the router drill
+    # absorbed both failures with zero client-visible failures, and
+    # the hedge drill cut the wedged tail with an exactly-once ledger
     ok = (
         len(report["levels"]) >= 3
         and report["overload"]["depth_bounded"]
@@ -799,6 +982,9 @@ def main(argv=None) -> int:
         and report["rollout"]["zero_5xx"]
         and report["router"]["zero_client_failures"]
         and report["router"]["requests"] > 0
+        and report["hedge"]["hedged_p99_improved"]
+        and report["hedge"]["hedges_fired"] >= 1
+        and report["hedge"]["ledger_exact"]
     )
     return 0 if ok else 1
 
