@@ -90,6 +90,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "gate — catches collectives gated on ranks the "
                          "dual-rank re-trace never simulates); "
                          "0 = off, needs N >= 2")
+    ap.add_argument("--fingerprint-snapshot", default=None,
+                    choices=["write", "check"],
+                    help="Persist ('write') or verify ('check') the "
+                         "per-combo ordered-collective fingerprints at "
+                         "--snapshot-path: write before a jax upgrade, "
+                         "check after — drifted combos flag as rule "
+                         "fingerprint-snapshot with both toolchain "
+                         "versions named (hybrid --mesh specs are "
+                         "fingerprinted too); requires the collectives "
+                         "layer")
+    ap.add_argument("--snapshot-path", default="dpt_fingerprints.json",
+                    metavar="PATH",
+                    help="Fingerprint snapshot artifact for "
+                         "--fingerprint-snapshot (default: "
+                         "dpt_fingerprints.json)")
     ap.add_argument("--plan", default=None, metavar="PATH",
                     help="Check a saved dpt_plan for staleness: re-trace "
                          "every fingerprinted point and flag rows whose "
@@ -128,6 +143,11 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         # check — skipping it silently would report a drifted plan clean
         print("analyze: --plan requires the collectives layer "
               "(--layer all|collectives)", file=sys.stderr)
+        return EXIT_INFRA
+    if args.fingerprint_snapshot and args.layer == "lint":
+        # same contract again: snapshot write/check trace programs
+        print("analyze: --fingerprint-snapshot requires the collectives "
+              "layer (--layer all|collectives)", file=sys.stderr)
         return EXIT_INFRA
     t0 = time.monotonic()
     findings: List = []
@@ -170,6 +190,32 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
                     world=args.fingerprint_world,
                 )
                 findings += ffindings
+            if args.fingerprint_snapshot == "write":
+                payload = collectives.write_fingerprint_snapshot(
+                    args.snapshot_path,
+                    strategies=strategies,
+                    schedules=args.schedules,
+                )
+                print(
+                    f"analyze: wrote "
+                    f"{len(payload['fingerprints'])} fingerprint(s) "
+                    f"(jax {payload['jax']}) to {args.snapshot_path}",
+                    file=sys.stderr,
+                )
+            elif args.fingerprint_snapshot == "check":
+                payload = collectives.load_fingerprint_snapshot(
+                    args.snapshot_path
+                )
+                if payload is None:
+                    # a missing/corrupt/version-skewed snapshot is a bad
+                    # invocation, not a clean check
+                    print(f"analyze: --snapshot-path "
+                          f"{args.snapshot_path}: not a readable "
+                          f"fingerprint snapshot", file=sys.stderr)
+                    return EXIT_INFRA
+                findings += collectives.check_fingerprint_snapshot(
+                    payload
+                )
             if args.plan:
                 from distributedpytorch_tpu.analysis.planner import (
                     check_plan_staleness,
@@ -202,6 +248,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         "lint_files": lint_files,
         "hlo": bool(args.hlo),
         "plan": args.plan,
+        "fingerprint_snapshot": args.fingerprint_snapshot,
         "duration_s": round(time.monotonic() - t0, 2),
     }
     out = sys.stderr if args.json_path == "-" else sys.stdout

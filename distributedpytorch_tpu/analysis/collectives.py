@@ -323,6 +323,30 @@ def extract_collectives(closed_jaxpr) -> List[Collective]:
         body_outs = {
             v for v in jaxpr.outvars if not _is_literal(v)
         }
+        # output-feeding closure through pure slicing/layout eqns: the
+        # in-stage-sharded 1f1b schedule slices each gradient leaf down
+        # to the device's own shard AFTER the schedule-closing psum
+        # (parallel/pipeline._slice_to_shard), so the psum's results
+        # reach the body outputs through a dynamic_slice — that still
+        # counts as output-feeding for the grad_output contract rows.
+        # Only the sliced operand (invars[0]) passes through; index
+        # operands do not.
+        pass_through = {
+            "dynamic_slice", "slice", "squeeze", "reshape",
+            "transpose", "convert_element_type",
+        }
+        changed = True
+        while changed:
+            changed = False
+            for eqn in jaxpr.eqns:
+                if eqn.primitive.name not in pass_through:
+                    continue
+                if not any(ov in body_outs for ov in eqn.outvars):
+                    continue
+                src = eqn.invars[0]
+                if not _is_literal(src) and src not in body_outs:
+                    body_outs.add(src)
+                    changed = True
         for i, eqn in enumerate(jaxpr.eqns):
             name = eqn.primitive.name
             if name in COLLECTIVE_PRIMS:
@@ -734,6 +758,132 @@ def fingerprint_combos(
         findings += combo_findings
         table[tag] = fps
     return dedupe(findings), table
+
+
+# -- fingerprint snapshots (the cross-upgrade drift gate) --------------------
+#: Artifact schema version; bump on incompatible payload changes.
+SNAPSHOT_VERSION = 1
+
+
+def _parse_combo_tag(tag: str) -> Tuple[str, Optional[str]]:
+    """Invert ``fingerprint_combos``' combo tag: ``'MP/gpipe'`` →
+    ``('MP', 'gpipe')``, ``'DP'`` → ``('DP', None)``. Methods never
+    contain ``/`` (legacy names and ``DxMxS[@rule]`` specs alike)."""
+    if "/" in tag:
+        method, schedule = tag.rsplit("/", 1)
+        return method, schedule
+    return tag, None
+
+
+def snapshot_fingerprints(
+    strategies: Sequence[str] = ANALYSIS_STRATEGIES,
+    schedules: Sequence[str] = ANALYSIS_SCHEDULES,
+) -> dict:
+    """The snapshot payload: every combo's rank-0 ordered-collective
+    fingerprint plus the toolchain identity it was traced under. Written
+    BEFORE a jax upgrade and checked after: a program that silently
+    changed shape across the upgrade (a collective reordered, dropped,
+    or re-axised by new tracing behavior) is exactly the drift the
+    per-run contract check cannot see — both sides of the upgrade can be
+    internally consistent yet different. Hybrid mesh specs fingerprint
+    through the same surface (pass them in ``strategies``, as the CLI's
+    ``--mesh`` merge does)."""
+    import jax
+    import jaxlib
+
+    fingerprints: Dict[str, str] = {}
+    for method, schedule in combos_for(strategies, schedules):
+        tag = f"{method}/{schedule}" if schedule else method
+        fingerprints[tag] = collective_fingerprint(method, schedule)
+    return {
+        "version": SNAPSHOT_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "fingerprints": fingerprints,
+    }
+
+
+def write_fingerprint_snapshot(
+    path: str,
+    strategies: Sequence[str] = ANALYSIS_STRATEGIES,
+    schedules: Sequence[str] = ANALYSIS_SCHEDULES,
+) -> dict:
+    """Trace, fingerprint, and persist — returns the written payload."""
+    import json
+
+    payload = snapshot_fingerprints(strategies, schedules)
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return payload
+
+
+def load_fingerprint_snapshot(path: str) -> Optional[dict]:
+    """The persisted payload, or None when missing/corrupt/version-skewed
+    — callers treat None as a bad invocation (rc 2), never as clean."""
+    import json
+
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    if payload.get("version") != SNAPSHOT_VERSION:
+        return None
+    if not isinstance(payload.get("fingerprints"), dict):
+        return None
+    return payload
+
+
+def check_fingerprint_snapshot(payload: dict) -> List[Finding]:
+    """Re-trace every combo a snapshot records and flag drift (rule
+    ``fingerprint-snapshot``): the current toolchain traces a DIFFERENT
+    ordered-collective program than the one recorded — after a jax
+    upgrade this is the audit trigger, not necessarily a bug, but it
+    must never pass silently. Combos that no longer trace at all are
+    flagged too (a refusal appearing where a program used to be is the
+    loudest possible drift)."""
+    import jax
+
+    recorded_jax = payload.get("jax", "unknown")
+    current_jax = jax.__version__
+    toolchain = (
+        f"recorded under jax {recorded_jax}, current jax {current_jax}"
+    )
+    findings: List[Finding] = []
+    for tag in sorted(payload["fingerprints"]):
+        recorded = payload["fingerprints"][tag]
+        method, schedule = _parse_combo_tag(tag)
+        try:
+            current = collective_fingerprint(method, schedule)
+        except Exception as exc:  # noqa: BLE001 — refusal IS the drift
+            findings.append(Finding(
+                rule="fingerprint-snapshot",
+                where=_combo_tag(method, schedule, "train"),
+                message=(
+                    f"combo no longer traces ({type(exc).__name__}: "
+                    f"{exc}) — {toolchain}; if the combo was removed "
+                    f"on purpose, re-write the snapshot"
+                ),
+                layer="collectives",
+            ))
+            continue
+        if current != recorded:
+            findings.append(Finding(
+                rule="fingerprint-snapshot",
+                where=_combo_tag(method, schedule, "train"),
+                message=(
+                    f"ordered-collective fingerprint drifted: recorded "
+                    f"{recorded} != current {current} ({toolchain}) — "
+                    f"the traced program changed shape across the "
+                    f"toolchain change; audit the program diff, then "
+                    f"re-write the snapshot to accept it"
+                ),
+                layer="collectives",
+            ))
+    return dedupe(findings)
 
 
 # -- HLO tier (opt-in: AOT compile, still zero execution) --------------------

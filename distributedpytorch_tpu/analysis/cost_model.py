@@ -178,6 +178,7 @@ def mesh_comms_program(
     param_storage_bytes: int = 0,
     grad_bytes: int = 0,
     level_planes: Iterable[Tuple[int, int]] = (),
+    stage: int = 1,
 ) -> List[CommOp]:
     """Analytic per-step comms for a mesh config whose collectives are
     GSPMD-inserted (empty jaxpr program) — the rule-engine
@@ -202,29 +203,62 @@ def mesh_comms_program(
       (n-1)/n to the whole buffer, exactly like the FSDP param
       all-gathers above).
 
+    With ``stage > 1`` the in-stage execution model changes the terms
+    (pipeline stages shard params via gather-at-use, parallel/pipeline.py):
+
+    * **model axis, ``channel`` role in-stage** — ONE param all-gather
+      per step at the top of the shard_map body (not per-conv activation
+      gathers: the stage computes on full params), transposing to one
+      gradient reduce-scatter on the backward. Payload is the stage's
+      own param slice — ``param_storage_bytes / stage`` — gathered
+      concurrently across stages;
+    * **data axis with ``fsdp`` in-stage** — the same gather-at-use
+      dance over the data axis: one STORAGE-dtype param all-gather plus
+      the f32 gradient reduce-scatter (not the flat-mesh 2-gather ZeRO
+      shape — the pipeline body gathers once, the vjp transposes it);
+    * **data axis, replicated params in-stage** — unchanged: the
+      schedule-closing gradient psum simply extends over
+      ``('stage', 'data')``.
+
     These were the planner's ``comms_model: none`` gap: SP/TP (and
     every model-axis hybrid) previously ranked with a silent zero-comms
     advantage. The terms are monotone in what they abstract — never a
     measurement."""
     program: List[CommOp] = []
-    d, m = int(data), int(model)
+    d, m, s = int(data), int(model), max(1, int(stage))
+    stage_params = param_storage_bytes // s
+    stage_grads = grad_bytes // s
     if d > 1:
         if "fsdp" in params_rule:
-            program += [
-                ("all_gather", param_storage_bytes, d),
-                ("all_gather", param_storage_bytes, d),
-                ("reduce_scatter", grad_bytes, d),
-            ]
+            if s > 1:
+                program += [
+                    ("all_gather", stage_params, d),
+                    ("reduce_scatter", stage_grads, d),
+                ]
+            else:
+                program += [
+                    ("all_gather", param_storage_bytes, d),
+                    ("all_gather", param_storage_bytes, d),
+                    ("reduce_scatter", grad_bytes, d),
+                ]
         else:
             program.append(("psum", grad_bytes, d))
     if m > 1:
-        for plane_bytes, row_bytes in level_planes:
-            for _ in range(2 * CONVS_PER_LEVEL):  # forward + backward
-                if model_role == "spatial":
-                    # boundary rows cross one link each way per conv
-                    program.append(("ppermute", 2 * int(row_bytes), m))
-                else:
-                    program.append(("all_gather", int(plane_bytes), m))
+        if s > 1 and model_role == "channel":
+            # in-stage channel-TP: gather-at-use param reconstruction,
+            # once per step, transposed to a grad reduce-scatter
+            program += [
+                ("all_gather", stage_params, m),
+                ("reduce_scatter", stage_grads, m),
+            ]
+        else:
+            for plane_bytes, row_bytes in level_planes:
+                for _ in range(2 * CONVS_PER_LEVEL):  # forward + backward
+                    if model_role == "spatial":
+                        # boundary rows cross one link each way per conv
+                        program.append(("ppermute", 2 * int(row_bytes), m))
+                    else:
+                        program.append(("all_gather", int(plane_bytes), m))
     return program
 
 

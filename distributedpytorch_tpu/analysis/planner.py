@@ -360,10 +360,34 @@ def evaluate_point(point: PlanPoint, image_size, widths,
                 cfg.image_size, widths, point.batch,
                 jnp.dtype(policy.compute_dtype).itemsize,
             ),
+            stage=mc.stage,
         )
         if program:
             comms_model = "analytic"
     comms_bytes, comms_s = cm.comms_summary(program, mesh_model)
+
+    # -- in-stage sharding advisory: hybrid pipeline points carry their
+    # gather-at-use collectives inside the traced jaxpr program already
+    # (counted in comms_s above); re-derive the analytic in-stage terms
+    # separately so the breakdown NAMES them — a 2x2x2 row shows what the
+    # model axis costs, not just a merged total. Advisory only: never
+    # added to cost_s (that would double-count the jaxpr gathers).
+    in_stage_s = None
+    mc = getattr(strategy, "mesh_config", None)
+    if mc is not None and mc.stage > 1 and (
+        (mc.model > 1 and mc.model_role == "channel")
+        or ("fsdp" in mc.params and mc.data > 1)
+    ):
+        in_stage_program = cm.mesh_comms_program(
+            data=mc.data,
+            model=mc.model,
+            model_role=mc.model_role,
+            params_rule=mc.params,
+            param_storage_bytes=_tree_bytes(params),
+            grad_bytes=_tree_count(params) * 4,
+            stage=mc.stage,
+        )
+        _, in_stage_s = cm.comms_summary(in_stage_program, mesh_model)
 
     # -- AOT compile: traced liveness + flops, nothing executes -------------
     compiled = compile_train_step_aot(strategy, model, tx, state, batch)
@@ -401,6 +425,8 @@ def evaluate_point(point: PlanPoint, image_size, widths,
     predicted["flops"] = flops
     predicted["comms_bytes"] = comms_bytes
     predicted["comms_model"] = comms_model
+    if in_stage_s is not None:
+        predicted["in_stage_comms_s"] = in_stage_s
     cost = predicted["cost_s"]
     predicted["imgs_per_s"] = (
         round(strategy.global_batch_size / cost, 2) if cost else None

@@ -36,11 +36,15 @@ plan-file path, and the elastic supervisor all import it without paying
 for a backend. Functions that construct jax objects import lazily.
 
 Execution limits (honest, enforced at strategy construction):
-``stage > 1`` with ``model > 1`` is not executable yet — the pipeline
-shard_map replicates params across its axes, and channel/spatial
-sharding inside a stage body needs hand-written collectives. The
-planner records such points as infeasible ``config:`` rejects instead
-of guessing.
+``stage > 1`` composes with ``model > 1`` (channel role) and ``@fsdp``
+— the pipeline schedules apply this module's per-tree rules IN-STAGE
+(parallel/pipeline.py "In-stage sharding": params enter the shard_map
+sharded per-leaf and are reconstructed with tiled all_gathers at the
+top of the step). The one remaining refusal is the 'spatial' model
+role inside a stage: its conv halo exchanges would have to run inside
+every tick's stage-gated cond, which the schedule's ppermute program
+cannot carry. The planner records THAT point as an infeasible
+``config:`` reject instead of guessing.
 """
 
 from __future__ import annotations
@@ -317,7 +321,12 @@ def derive_jaxpr_contract(
     Pipelined configs must show the inter-stage ppermutes and the
     whole-batch stats psum; the 1f1b schedule additionally must show the
     schedule-closing output-feeding gradient psum — whose 'data' axis IS
-    the DDP all-reduce on data-hybrid meshes."""
+    the DDP all-reduce on data-hybrid meshes. In-stage-sharded hybrids
+    (``model > 1`` channel role, or ``@fsdp`` with ``data > 1``) must
+    additionally show the per-step param-reconstruction all_gathers the
+    stage bodies run over the sharding axis (parallel/pipeline.py
+    ``_gather_params``) — the static checker covers these points
+    NON-EXEMPT, same as the flat schedules."""
     if not cfg.is_pipeline:
         return ()
     axes = frozenset({"stage"} | ({"data"} if cfg.data > 1 else set()))
@@ -332,6 +341,18 @@ def derive_jaxpr_contract(
          + (" across stages AND data shards" if hybrid
             and schedule == "gpipe" else "")),
     ]
+    if cfg.model > 1 and cfg.model_role == "channel":
+        rows.append((
+            "all_gather", frozenset({"model"}), False,
+            "in-stage channel-TP param reconstruction (gather-at-use, "
+            "once per step at the top of the shard_map body)",
+        ))
+    if "fsdp" in cfg.params and cfg.data > 1:
+        rows.append((
+            "all_gather", frozenset({"data"}), False,
+            "in-stage ZeRO param reconstruction over the data axis "
+            "(gather-at-use, once per step)",
+        ))
     if schedule == "1f1b":
         rows.append((
             "psum", axes, True,

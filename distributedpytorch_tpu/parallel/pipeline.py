@@ -85,6 +85,29 @@ Parameters are replicated across the stage axis (30 MB of params —
 replication is the right trade; what is *pipelined* is the activation
 traffic, which at (µB,640,960,32) per skip is the dominant term exactly as
 in the reference).
+
+In-stage sharding (hybrid ``DxMxS`` meshes, ``M>1`` and/or ``@fsdp``):
+when the builders receive the strategy's ``mesh_config``, the mesh's
+per-tree params rule (mesh.state_leaf_spec — channel-TP over 'model',
+ZeRO over 'data') applies INSIDE the stage functions. Params enter the
+shard_map sharded per-leaf; the body reconstructs each leaf with ONE
+tiled `all_gather` per sharded dim at the top of the step — before any
+tick's `lax.cond`, so no collective ever sits inside a stage-gated
+branch (which would deadlock the rendezvous and trip the analyzer's
+branch-divergent rule). Stage compute then runs on full params, the
+per-step gather being the ZeRO-3 trade scaled to the pipeline. The
+model axis carries NO schedule collective: replicas along it compute
+identically, so the stats/grad/BN psums still close over
+('stage'[, 'data']) only — extending them over 'model' would
+double-reduce. gpipe's backward needs no new code at all: shard_map's
+transpose machinery reduces the per-leaf cotangents back to each
+input's own shard layout (the all_gather transposes to a
+reduce_scatter), verified grad-exact against the plain step; 1f1b's
+explicit f32 accumulators stay full-size per device and each leaf is
+sliced back to its own shard after the closing psum, making the grads
+output sharded exactly like the params input. A 'spatial' model role
+inside a stage is refused loudly (halo exchanges would need to run
+inside every tick's cond).
 """
 
 from __future__ import annotations
@@ -370,6 +393,96 @@ def _reduce_grads(grads, axes):
     return jax.lax.psum(grads, axes)
 
 
+def _in_stage_config(mesh: Mesh, mesh_config):
+    """Gate for in-stage sharding: returns the mesh config when its
+    params rule actually shards leaves over an axis this mesh carries
+    (channel-TP over the model axis, ZeRO over 'data'), else None — and
+    the None path is byte-identical to the pre-hybrid flat schedules
+    (replicated params, ``P()`` in_specs). Refuses the spatial model
+    role: its halo exchanges would have to run inside every tick's
+    stage-gated ``lax.cond``, which the schedule's ppermute program does
+    not carry."""
+    if mesh_config is None:
+        return None
+    if mesh_config.model > 1 and mesh_config.model_role == "spatial":
+        raise ValueError(
+            "pipeline: a 'spatial' model role inside a stage is not "
+            "executable — spatial sharding halo-exchanges inside every "
+            "schedule tick, which the stage-gated lax.cond program "
+            "cannot carry; use the channel role on the model axis "
+            "(e.g. '2x2x2') or keep spatial sharding on a flat mesh "
+            "(e.g. '2x2x1@sp')"
+        )
+    model_tp = (
+        mesh_config.model > 1
+        and mesh_config.model_axis_name in mesh.axis_names
+    )
+    zero = (
+        "fsdp" in mesh_config.params
+        and mesh_config.data > 1
+        and "data" in mesh.axis_names
+    )
+    return mesh_config if (model_tp or zero) else None
+
+
+def _param_spec_tree(cfg, params):
+    """Per-leaf in-stage PartitionSpecs from the GLOBAL param shapes —
+    the same mesh.state_leaf_spec rule the strategy layer places state
+    with, evaluated OUTSIDE the shard_map (a local shard's shape could
+    flip a divisibility decision)."""
+    from distributedpytorch_tpu.parallel.mesh import state_leaf_spec
+
+    return jax.tree.map(lambda x: state_leaf_spec(cfg, x.shape), params)
+
+
+def _spec_axes(spec):
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        for name in (entry if isinstance(entry, tuple) else (entry,)):
+            yield dim, name
+
+
+def _gather_params(tree, specs):
+    """Reconstruct each leaf's full value from its in-stage shards: one
+    tiled `all_gather` per sharded dim, ONCE per step at the top of the
+    shard_map body. Replicas along the model axis then compute
+    identically, so the schedule's ppermutes/psums need no new axes."""
+    def gather(x, spec):
+        for dim, name in _spec_axes(spec):
+            x = jax.lax.all_gather(x, name, axis=dim, tiled=True)
+        return x
+
+    return jax.tree.map(gather, tree, specs)
+
+
+def _slice_to_shard(tree, specs, axis_sizes):
+    """The inverse of `_gather_params` for gradient outputs: 1f1b's f32
+    accumulators are full-size per device, so after the closing psum
+    each leaf is sliced down to this device's own shard per its spec —
+    the grads then leave the shard_map sharded exactly like the params
+    entered (out_specs = the same spec tree)."""
+    def slice_leaf(x, spec):
+        for dim, name in _spec_axes(spec):
+            n = int(axis_sizes[name])
+            if n == 1:
+                continue
+            shard = x.shape[dim] // n
+            idx = jax.lax.axis_index(name)
+            x = jax.lax.dynamic_slice_in_dim(x, idx * shard, shard, axis=dim)
+        return x
+
+    return jax.tree.map(slice_leaf, tree, specs)
+
+
+def _shape_key(tree):
+    """Cache key for the lazily-built in-stage shard_maps: the spec
+    trees depend only on the global leaf shapes (one model = one key in
+    practice; direct API users swapping param shapes get a fresh
+    build)."""
+    return tuple(tuple(x.shape) for x in jax.tree.leaves(tree))
+
+
 def _stats_fn(use_pallas: bool):
     if use_pallas:
         from distributedpytorch_tpu.ops.fused_loss import bce_dice_stats_fused
@@ -396,6 +509,7 @@ def make_pipeline_loss_fn(
     remat: bool = False,
     cuts: Optional[Sequence[int]] = None,
     use_pallas: bool = False,
+    mesh_config=None,
 ) -> Callable:
     """Build the fill-drain (gpipe) pipeline loss over `mesh`'s ``stage``
     axis (S = the axis size): ``loss_fn(params, batch) -> loss`` for
@@ -412,7 +526,12 @@ def make_pipeline_loss_fn(
     one-pass Pallas kernel + its analytic VJP (ops/fused_loss.py) — legal
     here because inside the shard_map schedule every array is
     device-local, exactly where pallas_call belongs.
+
+    ``mesh_config`` (the strategy's MeshConfig) engages in-stage param
+    sharding on hybrid meshes — see the module docstring; None keeps the
+    replicated-params flat path bit-identical.
     """
+    in_stage = _in_stage_config(mesh, mesh_config)
     data_axis = _resolve_data_axis(mesh, data_axis)
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
@@ -426,7 +545,9 @@ def make_pipeline_loss_fn(
     axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
     batch_in_spec = {"image": batch_spec, "mask": batch_spec}
 
-    def per_device(params, model_state, batch):
+    def per_device(params, model_state, batch, specs=None):
+        if specs is not None:
+            params = _gather_params(params, specs)
         images = batch["image"]
         masks = batch["mask"]
         mb = _check_microbatching(images.shape[0], M)
@@ -461,24 +582,59 @@ def make_pipeline_loss_fn(
             return loss, _combine_bn(model_state, bn_final, stage_axis, data_axis)
         return loss, None
 
-    if stateful:
-        sharded = shard_map(
-            per_device,
+    if in_stage is None:
+        if stateful:
+            return shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(), batch_in_spec),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        return shard_map(
+            lambda params, batch: per_device(params, None, batch)[0],
             mesh=mesh,
-            in_specs=(P(), P(), batch_in_spec),
-            out_specs=(P(), P()),
+            in_specs=(P(), batch_in_spec),
+            out_specs=P(),
             check_vma=False,
         )
-        return sharded
 
-    stateless = shard_map(
-        lambda params, batch: per_device(params, None, batch)[0],
-        mesh=mesh,
-        in_specs=(P(), batch_in_spec),
-        out_specs=P(),
-        check_vma=False,
-    )
-    return stateless
+    # in-stage sharding: the per-leaf spec tree depends on the GLOBAL
+    # param shapes, so the shard_map is built lazily at first call (and
+    # cached per shape signature — one model, one build)
+    cache = {}
+
+    def _built(params):
+        key = _shape_key(params)
+        fn = cache.get(key)
+        if fn is None:
+            specs = _param_spec_tree(in_stage, params)
+            if stateful:
+                fn = shard_map(
+                    functools.partial(per_device, specs=specs),
+                    mesh=mesh,
+                    in_specs=(specs, P(), batch_in_spec),
+                    out_specs=(P(), P()),
+                    check_vma=False,
+                )
+            else:
+                fn = shard_map(
+                    lambda p, b: per_device(p, None, b, specs=specs)[0],
+                    mesh=mesh,
+                    in_specs=(specs, batch_in_spec),
+                    out_specs=P(),
+                    check_vma=False,
+                )
+            cache[key] = fn
+        return fn
+
+    if stateful:
+        def loss_fn(params, model_state, batch):
+            return _built(params)(params, model_state, batch)
+    else:
+        def loss_fn(params, batch):
+            return _built(params)(params, batch)
+    return loss_fn
 
 
 def make_pipeline_value_and_grad_fn(
@@ -491,6 +647,7 @@ def make_pipeline_value_and_grad_fn(
     cuts: Optional[Sequence[int]] = None,
     use_pallas: bool = False,
     schedule: str = "1f1b",
+    mesh_config=None,
 ) -> Callable:
     """Build ``f(params, model_state, batch) -> (loss, grads, model_state')``
     for either pipeline schedule (``model_state`` is None for stateless
@@ -517,6 +674,7 @@ def make_pipeline_value_and_grad_fn(
             f"pipeline schedule must be one of {PIPELINE_SCHEDULES}, "
             f"got {schedule!r}"
         )
+    in_stage = _in_stage_config(mesh, mesh_config)
     data_axis = _resolve_data_axis(mesh, data_axis)
     stateful = _is_stateful(model)
 
@@ -524,7 +682,7 @@ def make_pipeline_value_and_grad_fn(
         loss_fn = make_pipeline_loss_fn(
             model, mesh, num_microbatches=num_microbatches,
             stage_axis=stage_axis, data_axis=data_axis, remat=remat,
-            cuts=cuts, use_pallas=use_pallas,
+            cuts=cuts, use_pallas=use_pallas, mesh_config=mesh_config,
         )
 
         def _wide(params):
@@ -560,7 +718,9 @@ def make_pipeline_value_and_grad_fn(
     axes = (stage_axis, data_axis) if data_axis else (stage_axis,)
     batch_in_spec = {"image": batch_spec, "mask": batch_spec}
 
-    def per_device(params, model_state, batch):
+    def per_device(params, model_state, batch, specs=None):
+        if specs is not None:
+            params = _gather_params(params, specs)
         images = batch["image"]
         masks = batch["mask"]
         mb = _check_microbatching(images.shape[0], M)
@@ -697,30 +857,75 @@ def make_pipeline_value_and_grad_fn(
                 for e in range(S - 1)
             ]
         grads = _reduce_grads(grads, axes)
+        if specs is not None:
+            # the model axis carried no reduction (its replicas'
+            # accumulators are identical); slice each full leaf down to
+            # this device's own shard so the grads leave the shard_map
+            # laid out exactly like the params entered
+            grads = _slice_to_shard(grads, specs, dict(mesh.shape))
         return loss, grads, new_model_state
 
-    if stateful:
-        return shard_map(
-            per_device,
+    if in_stage is None:
+        if stateful:
+            return shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(), batch_in_spec),
+                out_specs=(P(), P(), P()),
+                check_vma=False,
+            )
+
+        sharded = shard_map(
+            lambda params, batch: per_device(params, None, batch)[:2],
             mesh=mesh,
-            in_specs=(P(), P(), batch_in_spec),
-            out_specs=(P(), P(), P()),
+            in_specs=(P(), batch_in_spec),
+            out_specs=(P(), P()),
             check_vma=False,
         )
 
-    sharded = shard_map(
-        lambda params, batch: per_device(params, None, batch)[:2],
-        mesh=mesh,
-        in_specs=(P(), batch_in_spec),
-        out_specs=(P(), P()),
-        check_vma=False,
-    )
+        def stateless_vag(params, model_state, batch):
+            loss, grads = sharded(params, batch)
+            return loss, grads, model_state
 
-    def stateless_vag(params, model_state, batch):
-        loss, grads = sharded(params, batch)
-        return loss, grads, model_state
+        return stateless_vag
 
-    return stateless_vag
+    # in-stage sharding: lazily built per global param shapes (the spec
+    # tree is both the params in_spec and the grads out_spec)
+    cache = {}
+
+    def _built(params):
+        key = _shape_key(params)
+        fn = cache.get(key)
+        if fn is None:
+            specs = _param_spec_tree(in_stage, params)
+            if stateful:
+                fn = shard_map(
+                    functools.partial(per_device, specs=specs),
+                    mesh=mesh,
+                    in_specs=(specs, P(), batch_in_spec),
+                    out_specs=(P(), specs, P()),
+                    check_vma=False,
+                )
+            else:
+                fn = shard_map(
+                    lambda p, b: per_device(p, None, b, specs=specs)[:2],
+                    mesh=mesh,
+                    in_specs=(specs, batch_in_spec),
+                    out_specs=(P(), specs),
+                    check_vma=False,
+                )
+            cache[key] = fn
+        return fn
+
+    if stateful:
+        def sharded_vag(params, model_state, batch):
+            return _built(params)(params, model_state, batch)
+    else:
+        def sharded_vag(params, model_state, batch):
+            loss, grads = _built(params)(params, batch)
+            return loss, grads, model_state
+
+    return sharded_vag
 
 
 def make_pipeline_forward_fn(
@@ -730,6 +935,7 @@ def make_pipeline_forward_fn(
     stage_axis: str = "stage",
     data_axis: str = "auto",
     cuts: Optional[Sequence[int]] = None,
+    mesh_config=None,
 ) -> Callable:
     """Pipelined inference: ``forward(variables, images) -> preds``.
 
@@ -740,6 +946,7 @@ def make_pipeline_forward_fn(
     stage axis so the output is replicated over 'stage' (the reference's
     ``.to('cuda:0')`` gather, unet_model.py:53).
     """
+    in_stage = _in_stage_config(mesh, mesh_config)
     data_axis = _resolve_data_axis(mesh, data_axis)
     num_stages = mesh.shape[stage_axis]
     stage_ranges = _stage_ranges(model.num_segments, num_stages, cuts)
@@ -749,12 +956,14 @@ def make_pipeline_forward_fn(
     S = num_stages
     batch_spec = P(data_axis) if data_axis else P()
 
-    def per_device(variables, images):
+    def per_device(variables, images, specs=None):
         if stateful:
             params = variables["params"]
             bn = variables["batch_stats"]
         else:
             params, bn = variables, None
+        if specs is not None:
+            params = _gather_params(params, specs)
         # same guard as the train paths: a ragged batch would silently
         # floor to mb=0 (empty predictions) or drop samples here
         mb = _check_microbatching(images.shape[0], M)
@@ -780,10 +989,35 @@ def make_pipeline_forward_fn(
         # output, the rest hold zeros → psum is a broadcast-from-last-stage.
         return jax.lax.psum(out, stage_axis)
 
-    return shard_map(
-        per_device,
-        mesh=mesh,
-        in_specs=(P(), batch_spec),
-        out_specs=batch_spec,
-        check_vma=False,
-    )
+    if in_stage is None:
+        return shard_map(
+            per_device,
+            mesh=mesh,
+            in_specs=(P(), batch_spec),
+            out_specs=batch_spec,
+            check_vma=False,
+        )
+
+    # in-stage sharding: params enter per-leaf sharded (batch_stats, for
+    # stateful models, stay replicated — the running averages are read
+    # whole by every stage)
+    cache = {}
+
+    def forward(variables, images):
+        params = variables["params"] if stateful else variables
+        key = _shape_key(params)
+        fn = cache.get(key)
+        if fn is None:
+            specs = _param_spec_tree(in_stage, params)
+            var_spec = {"params": specs, "batch_stats": P()} if stateful else specs
+            fn = shard_map(
+                functools.partial(per_device, specs=specs),
+                mesh=mesh,
+                in_specs=(var_spec, batch_spec),
+                out_specs=batch_spec,
+                check_vma=False,
+            )
+            cache[key] = fn
+        return fn(variables, images)
+
+    return forward

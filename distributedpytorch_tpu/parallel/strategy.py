@@ -220,7 +220,20 @@ class Strategy:
             return jax.device_put(state, dev)
         if self.mesh_config.params == "replicate":
             return _replicate(self.mesh, state)
-        return _shard_state_by_rule(state, self.mesh, self._leaf_spec, self.name)
+        placed = _shard_state_by_rule(
+            state, self.mesh, self._leaf_spec, self.name
+        )
+        if self.is_pipeline and state.model_state is not None:
+            # the pipeline schedules read batch_stats whole on every
+            # stage (in_specs P()); placing it sharded would force a
+            # gather-then-resharding recompile on the second step
+            placed = TrainState(
+                params=placed.params,
+                opt_state=placed.opt_state,
+                step=placed.step,
+                model_state=_replicate(self.mesh, state.model_state),
+            )
+        return placed
 
     def _leaf_spec(self, shape) -> P:
         """The per-tree params/opt-state rule — one definition
@@ -313,6 +326,7 @@ class Strategy:
             cuts=self.config.pipeline_cuts,
             use_pallas=self.kernels.train_loss_fused,
             schedule=self.config.pipeline_schedule,
+            mesh_config=self.mesh_config,
         )
         # per-process batch, same rationale as the plain step's scale
         grad_scale = (
@@ -385,6 +399,7 @@ class Strategy:
             self.mesh,
             num_microbatches=self.config.num_microbatches,
             cuts=self.config.pipeline_cuts,
+            mesh_config=self.mesh_config,
         )
 
     def build_eval_step(self, model) -> Callable:
@@ -946,9 +961,13 @@ class GenericMesh(MultiProcessMixin, Strategy):
     Semantics follow the multi-process (torchrun/FSDP) convention:
     ``batch_size`` is per-process, no DDP lr quirk. Explicit specs fail
     LOUDLY on infeasible divisibility (no silent mesh shrinking — the
-    user named an exact geometry). ``stage > 1`` with ``model > 1`` is
-    not executable yet (the pipeline shard_map replicates params across
-    its axes); the planner records such points as honest rejects."""
+    user named an exact geometry). ``stage > 1`` with ``model > 1``
+    runs the pipeline schedules with IN-STAGE sharding: the mesh's
+    per-tree params rule (channel-TP over 'model', ZeRO over 'data')
+    applies inside the stage functions (parallel/pipeline.py, module
+    docstring "In-stage sharding"). The one remaining refusal is the
+    'spatial' model role inside a stage — its halo exchanges cannot
+    ride the tick program's stage-gated conds."""
 
     name = "mesh"
 
@@ -961,12 +980,16 @@ class GenericMesh(MultiProcessMixin, Strategy):
                 f"mesh {self.name} needs {cfg.size} devices, "
                 f"got {len(devs)}"
             )
-        if cfg.stage > 1 and cfg.model > 1:
+        if cfg.stage > 1 and cfg.model > 1 and cfg.model_role == "spatial":
             raise ValueError(
-                f"mesh {self.name}: configs with both a 'model' and a "
-                f"'stage' axis are not executable yet — the pipeline "
-                f"shard_map replicates params across its axes; drop one "
-                f"axis or wait for in-stage sharding"
+                f"mesh {self.name}: a 'spatial' model role inside a "
+                f"pipeline stage is not executable — spatial sharding "
+                f"halo-exchanges inside every schedule tick, which the "
+                f"stage-gated lax.cond program cannot carry; use the "
+                f"channel role on the model axis "
+                f"('{cfg.data}x{cfg.model}x{cfg.stage}') or keep spatial "
+                f"sharding on a flat mesh "
+                f"('{cfg.data}x{cfg.model}x1@sp')"
             )
         # divisibility is judged on the GLOBAL batch: mesh specs use
         # the torchrun convention (batch_size is per-process) while the

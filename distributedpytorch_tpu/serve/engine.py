@@ -210,14 +210,15 @@ class ServeEngine:
         # store entry would be refused ("Symbols not found") by every
         # sibling process that tries to load it. Codegen fresh: the AOT
         # store replaces exactly what the XLA cache would have saved.
-        import jax
+        # (no_xla_compilation_cache also resets jax's memoized
+        # is-cache-used state — a bare flag flip is silently ignored
+        # after the process's first compile.)
+        from distributedpytorch_tpu.utils.aotstore import (
+            no_xla_compilation_cache,
+        )
 
-        prev = jax.config.jax_enable_compilation_cache
-        jax.config.update("jax_enable_compilation_cache", False)
-        try:
+        with no_xla_compilation_cache():
             return jitted.lower(vars_dev, x_sds).compile()
-        finally:
-            jax.config.update("jax_enable_compilation_cache", prev)
 
     def _entry_key(self, bucket: int, device) -> Tuple[str, dict]:
         """Store key for one bucket executable on one device. The

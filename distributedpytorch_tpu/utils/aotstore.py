@@ -43,6 +43,7 @@ engine arg wins, else ``$DPT_AOT_CACHE``, else the store is off.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import itertools
 import json
@@ -77,6 +78,41 @@ class AOTEntryError(Exception):
     """One unusable store entry (torn, corrupt, or schema-broken) —
     always caught inside :meth:`AOTStore.load` and converted to a
     counted ``skew`` refusal."""
+
+
+@contextlib.contextmanager
+def no_xla_compilation_cache():
+    """A window in which jax's persistent compilation cache is REALLY
+    off — for both reads and writes.
+
+    The AOT store replaces exactly what the XLA cache would provide, and
+    the two must never compose: an executable rehydrated from the XLA
+    cache serializes WITHOUT its backend kernel symbols, so a store
+    entry written from (or a load routed through) a cache hit dies on
+    the next deserialize with "Symbols not found". Flipping
+    ``jax_enable_compilation_cache`` alone is NOT enough: jax memoizes
+    "is the cache used" process-wide at the first compile
+    (``compilation_cache.is_cache_used``), after which per-call flag
+    flips are ignored. So the window resets that memoized state on the
+    way in (re-checked lazily against the now-disabled flag) and again
+    on the way out (so later ordinary compiles re-enable the cache).
+    Disk contents are untouched either way.
+    """
+    import jax
+
+    try:
+        from jax._src import compilation_cache as _cc
+        reset = _cc.reset_cache
+    except Exception:  # pragma: no cover — future-jax fallback
+        reset = lambda: None  # noqa: E731
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    reset()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
+        reset()
 
 
 def runtime_versions() -> Dict[str, str]:
@@ -265,7 +301,8 @@ class AOTStore:
                 )
 
                 blob, in_tree, out_tree = pickle.loads(payload)
-                compiled = deserialize_and_load(blob, in_tree, out_tree)
+                with no_xla_compilation_cache():
+                    compiled = deserialize_and_load(blob, in_tree, out_tree)
             else:
                 raise AOTEntryError(reason)
         except Exception as exc:  # noqa: BLE001 — every failure mode of

@@ -267,6 +267,93 @@ class TestCollectiveFingerprint:
 
 
 # ---------------------------------------------------------------------------
+class TestFingerprintSnapshot:
+    """The ``fingerprint-snapshot`` rule (PR 19 satellite): persist each
+    combo's ordered-collective fingerprint with the toolchain identity,
+    and compare across jax upgrades — drift both sides of an upgrade can
+    be internally consistent about, which the per-run contract check can
+    therefore never see. Hybrid mesh specs ride the same surface."""
+
+    def test_write_then_check_roundtrip_clean(self, tmp_path, no_compile):
+        path = tmp_path / "snap.json"
+        payload = collectives.write_fingerprint_snapshot(
+            str(path), strategies=["MP", "2x2x2"], schedules=["1f1b"],
+        )
+        assert set(payload["fingerprints"]) == {"MP/1f1b", "2x2x2/1f1b"}
+        assert payload["jax"] == jax.__version__
+        loaded = collectives.load_fingerprint_snapshot(str(path))
+        assert loaded == payload
+        assert collectives.check_fingerprint_snapshot(loaded) == []
+
+    def test_seeded_drift_is_flagged_with_both_versions(
+        self, tmp_path, no_compile
+    ):
+        path = tmp_path / "snap.json"
+        payload = collectives.write_fingerprint_snapshot(
+            str(path), strategies=["MP"], schedules=["gpipe"],
+        )
+        payload["fingerprints"]["MP/gpipe"] = "0" * 16
+        payload["jax"] = "0.0.1"
+        findings = collectives.check_fingerprint_snapshot(payload)
+        assert [f.rule for f in findings] == ["fingerprint-snapshot"]
+        assert "recorded under jax 0.0.1" in findings[0].message
+        assert f"current jax {jax.__version__}" in findings[0].message
+
+    def test_vanished_combo_is_the_loudest_drift(self, no_compile):
+        payload = {
+            "version": collectives.SNAPSHOT_VERSION,
+            "jax": "0.0.1", "jaxlib": "0.0.1",
+            "fingerprints": {"1x2x2@sp/gpipe": "f" * 16},
+        }
+        findings = collectives.check_fingerprint_snapshot(payload)
+        assert [f.rule for f in findings] == ["fingerprint-snapshot"]
+        assert "no longer traces" in findings[0].message
+
+    def test_unreadable_snapshot_is_none_never_clean(self, tmp_path):
+        assert collectives.load_fingerprint_snapshot(
+            str(tmp_path / "missing.json")) is None
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert collectives.load_fingerprint_snapshot(str(bad)) is None
+        skew = tmp_path / "skew.json"
+        skew.write_text(json.dumps({"version": 999, "fingerprints": {}}))
+        assert collectives.load_fingerprint_snapshot(str(skew)) is None
+
+    def test_cli_check_flags_drift_and_missing_is_infra(self, tmp_path):
+        path = tmp_path / "snap.json"
+        rc = analyze_cli_run([
+            "--layer", "collectives", "--strategies", "MP",
+            "--schedules", "gpipe", "--no-rank-check",
+            "--fingerprint-snapshot", "write", "--snapshot-path",
+            str(path),
+        ])
+        assert rc == 0
+        payload = json.loads(path.read_text())
+        payload["fingerprints"]["MP/gpipe"] = "d" * 16
+        path.write_text(json.dumps(payload))
+        rc = analyze_cli_run([
+            "--layer", "collectives", "--strategies", "MP",
+            "--schedules", "gpipe", "--no-rank-check",
+            "--fingerprint-snapshot", "check", "--snapshot-path",
+            str(path),
+        ])
+        assert rc == 1
+        rc = analyze_cli_run([
+            "--layer", "collectives", "--strategies", "MP",
+            "--schedules", "gpipe", "--no-rank-check",
+            "--fingerprint-snapshot", "check", "--snapshot-path",
+            str(tmp_path / "missing.json"),
+        ])
+        assert rc == 2
+        # lint-only layer can't trace: refuse, never a false clean
+        rc = analyze_cli_run([
+            "--layer", "lint", "--fingerprint-snapshot", "check",
+            "--snapshot-path", str(path),
+        ])
+        assert rc == 2
+
+
+# ---------------------------------------------------------------------------
 class TestContractTables:
     def test_jaxpr_contract_covers_every_analyzed_combo(self):
         for method, schedule in collectives.combos_for():

@@ -341,8 +341,11 @@ class TestNewGeometries:
         assert max(per_dev.values()) <= total / 4 * 1.6
 
     def test_infeasible_geometries_fail_loudly(self):
-        with pytest.raises(ValueError, match="'model' and a 'stage'"):
-            build_strategy(_config("2x2x2"))
+        # model x stage with the channel role builds now (PR 19 in-stage
+        # sharding, tests/test_hybrid_pipeline.py); spatial-in-stage is
+        # the one remaining refusal
+        with pytest.raises(ValueError, match="spatial.*not executable"):
+            build_strategy(_config("2x2x2@sp"))
         with pytest.raises(ValueError, match="devices"):
             build_strategy(_config("9x1x1"))
         with pytest.raises(ValueError, match="never shrink"):
@@ -466,16 +469,46 @@ class TestDerivedContracts:
         assert C.analyze_combo("3x1x2", "gpipe", rank_check=False) == []
 
     def test_unbuildable_spec_is_a_finding_not_a_crash(self):
-        """A parseable spec the rig cannot BUILD (model x stage) refuses
-        with an actionable mesh-config finding — the launch preflights
-        turn it into a pre-spawn refusal, and an `analyze --mesh` run
-        keeps its other combos' results instead of aborting as infra."""
+        """A parseable spec the rig cannot BUILD (spatial-in-stage, the
+        one refusal left after PR 19's in-stage sharding) refuses with
+        an actionable mesh-config finding — the launch preflights turn
+        it into a pre-spawn refusal, and an `analyze --mesh` run keeps
+        its other combos' results instead of aborting as infra."""
         from distributedpytorch_tpu.analysis import collectives as C
 
-        findings = C.analyze_combo("2x2x2", "gpipe", rank_check=False)
+        findings = C.analyze_combo("1x2x2@sp", "gpipe", rank_check=False)
         assert len(findings) == 1
         assert findings[0].rule == "mesh-config"
         assert "not executable" in findings[0].message
+
+    def test_hybrid_mesh_specs_analyze_clean(self):
+        """The PR 19 acceptance points pass the static checker with
+        non-exempt derived contracts (the in-stage all_gather rows are
+        REQUIRED — see _contract_requirements)."""
+        from distributedpytorch_tpu.analysis import collectives as C
+        from distributedpytorch_tpu.parallel import mesh as M
+
+        # three combos cover every spec and both schedules (the full
+        # 3x2 cross product re-traces the same stage graphs; the CI
+        # pipeline-schedules step compiles them all anyway)
+        for spec, schedule in (
+            ("2x2x2", "gpipe"),
+            ("1x2x2@fsdp", "1f1b"),
+            ("2x2x2@fsdp", "1f1b"),
+        ):
+            assert C.analyze_combo(spec, schedule, rank_check=False) == []
+        cfg = M.parse_mesh_spec("2x2x2")
+        rows = M.derive_jaxpr_contract(cfg, "gpipe")
+        assert any(
+            kind == "all_gather" and set(axes) == {"model"}
+            for kind, axes, *_ in rows
+        )
+        cfg_f = M.parse_mesh_spec("2x2x2@fsdp")
+        rows_f = M.derive_jaxpr_contract(cfg_f, "1f1b")
+        assert any(
+            kind == "all_gather" and set(axes) == {"data"}
+            for kind, axes, *_ in rows_f
+        )
 
     def test_analyze_cli_grows_mesh_flag(self):
         from distributedpytorch_tpu.analysis import cli as acli
@@ -602,11 +635,14 @@ class TestPlannerMeshAxis:
             ), strategy
         assert wall["ranking"][0].startswith("2x1x2/")
 
-    def test_model_x_stage_rejects_as_config(self):
+    # (the matching positive flip — 2x2x2 now plans FEASIBLE with the
+    # in-stage terms in its breakdown — is pinned where the ISSUE asks
+    # for it: tests/test_planner.py::TestModelStagePlannerFlip)
+    def test_spatial_in_stage_rejects_as_config(self):
         from distributedpytorch_tpu.analysis import planner
 
         p = planner.plan(**self._grid(
-            strategies=(), meshes=("1x2x4",),
+            strategies=(), meshes=("1x2x2@sp",),
         ))
         row = p["points"][0]
         assert row["feasible"] is False

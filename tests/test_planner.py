@@ -104,6 +104,68 @@ class TestCostModel:
         assert out["compute_s"] is None and out["hbm_s"] is None
         assert out["cost_s"] == 1e-5
 
+    def test_in_stage_terms_replace_per_conv_gathers(self):
+        """stage>1 + channel model axis: ONE gather-at-use param
+        all-gather (the stage's own param slice) + the transposed grad
+        reduce-scatter — not the flat mesh's per-conv activation
+        gathers; and the in-stage ZeRO dance gathers once, not twice."""
+        flat = cm.mesh_comms_program(
+            data=2, model=2, param_storage_bytes=1000, grad_bytes=2000,
+            level_planes=[(64, 8)],
+        )
+        staged = cm.mesh_comms_program(
+            data=2, model=2, param_storage_bytes=1000, grad_bytes=2000,
+            level_planes=[(64, 8)], stage=2,
+        )
+        assert staged == [
+            ("psum", 2000, 2),          # schedule-closing grad psum
+            ("all_gather", 500, 2),     # per-stage param slice, model
+            ("reduce_scatter", 1000, 2),
+        ]
+        # flat keeps the per-conv channel terms (2*CONVS_PER_LEVEL)
+        assert sum(1 for k, _, _ in flat if k == "all_gather") == 8
+        zero = cm.mesh_comms_program(
+            data=2, model=1, params_rule="fsdp",
+            param_storage_bytes=1000, grad_bytes=2000, stage=2,
+        )
+        assert zero == [("all_gather", 500, 2),
+                        ("reduce_scatter", 1000, 2)]
+        # stage=1 path is byte-identical to before the parameter existed
+        assert cm.mesh_comms_program(
+            data=2, model=1, params_rule="fsdp",
+            param_storage_bytes=1000, grad_bytes=2000,
+        ) == [("all_gather", 1000, 2), ("all_gather", 1000, 2),
+              ("reduce_scatter", 2000, 2)]
+
+
+# ---------------------------------------------------------------------------
+class TestModelStagePlannerFlip:
+    """PR 19's planner flip: ``2x2x2`` was an honest mesh-config reject
+    at PR 15 ('model' and 'stage' not executable together); with
+    in-stage sharding it evaluates FEASIBLE — the traced jaxpr program
+    carries the gather-at-use collectives, and the predicted breakdown
+    names the in-stage terms (``in_stage_comms_s``, advisory — the jaxpr
+    comms time already counts the real gathers)."""
+
+    def test_2x2x2_point_now_feasible_with_in_stage_breakdown(self):
+        p = planner.plan(**_grid(
+            strategies=(), meshes=("2x2x2",), schedules=("gpipe",),
+            microbatches=(2,),
+        ))
+        row = p["points"][0]
+        assert row["feasible"] is True, row["reject"]
+        predicted = row["predicted"]
+        assert predicted["comms_model"] == "jaxpr"
+        assert predicted["comms_bytes"] > 0
+        assert predicted["in_stage_comms_s"] > 0
+        # advisory, never double-counted into the ranked cost
+        assert predicted["in_stage_comms_s"] <= predicted["comms_s"]
+        assert row["rank"] is not None
+
+    def test_flat_pipeline_point_carries_no_in_stage_term(self, tiny_plan):
+        for row in tiny_plan["points"]:
+            assert "in_stage_comms_s" not in (row.get("predicted") or {})
+
 
 # ---------------------------------------------------------------------------
 class TestTinyPlanEndToEnd:

@@ -48,7 +48,8 @@ import time
 #: program's structure is set by the stage count, not the data degree
 #: — a capped data axis loses nothing the sweep's hybrid-vs-pure A/B
 #: needs); tests/test_mesh.py pins the closure over a wide pool range.
-PREFLIGHT_STAGE_SPECS = ("1x1x2", "2x1x2", "3x1x2", "4x1x2", "2x1x4")
+PREFLIGHT_STAGE_SPECS = ("1x1x2", "2x1x2", "3x1x2", "4x1x2", "2x1x4",
+                         "2x2x2", "2x2x2@fsdp")
 
 
 def default_specs(n_devices: int):
@@ -68,7 +69,11 @@ def default_specs(n_devices: int):
         specs += [f"{min(n // 2, 4)}x1x2", f"{n // 2}x2x1",
                   f"{n // 2}x2x1@fsdp"]
     if n >= 8:
-        specs += [f"{min(n // 4, 2)}x1x4"]
+        specs += [f"{min(n // 4, 2)}x1x4",
+                  # model x stage hybrids (PR 19 in-stage sharding):
+                  # fixed 2x2x2 cells regardless of pool growth — the
+                  # preflight allowlist vets exactly these graphs
+                  "2x2x2", "2x2x2@fsdp"]
     return specs
 
 
