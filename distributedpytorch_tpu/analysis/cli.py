@@ -1,11 +1,29 @@
-"""``python -m distributedpytorch_tpu analyze`` — the dptlint driver.
+"""``python -m distributedpytorch_tpu analyze`` — the dptverify driver.
 
-Runs both layers (jaxpr collective checker + AST source lint), prints one
-actionable line per finding, and exits 0 (clean) / 1 (findings) /
-2 (analyzer infrastructure failure — callers must NOT treat this as a
-finding). ``--json`` writes the machine-readable report (``-`` =
-stdout), which the CI job uploads as an artifact on failure and the
-bench_multi / elastic preflights parse.
+Runs every static pass, prints one actionable line per finding, and
+exits 0 (clean) / 1 (findings) / 2 (analyzer infrastructure failure —
+callers must NOT treat this as a finding). ``--json`` writes the
+machine-readable report (``-`` = stdout), which the CI job uploads as
+an artifact on failure and the bench_multi / elastic preflights parse;
+``--sarif`` additionally projects the findings into SARIF 2.1.0 for
+CI PR-diff annotation (the JSON report stays canonical).
+
+The passes ride the two coarse layers:
+
+* ``--layer collectives`` (jax, trace-only): the train AND eval comms
+  contracts per strategy × schedule (dropped eval psum = finding), the
+  serve-variant collective-freedom checks (float/int8/pallas forwards
+  must trace with zero collectives), and the donation-safety pass
+  (every serve variant lowered through ``serve/engine.serve_jit`` must
+  be donation-free at the intent and aliasing tiers).
+* ``--layer lint`` (pure AST + pure Python, jax-free): the source
+  lint — including suppression hygiene (unknown/stale ``dptlint:
+  disable`` comments are themselves findings).
+* The control-plane protocol explorer (``analysis/protocol.py`` —
+  router HA arbitration, rollout canary machine, experiment/capacity
+  interleavings, fleet rank selection, model-checked exhaustively in
+  milliseconds) is jax-free and runs under EVERY layer selection, so
+  both launch preflights and the cold CI lint job get it for free.
 
 Self-provisioning: the collective layer traces pipeline strategies over
 an 8-device virtual CPU mesh, and jax backends initialize once per
@@ -125,6 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="PATH",
                     help="Write the JSON report here ('-' = stdout; "
                          "findings lines then go to stderr)")
+    ap.add_argument("--sarif", dest="sarif_path", default=None,
+                    metavar="PATH",
+                    help="Also write the findings as SARIF 2.1.0 (for "
+                         "CI PR-diff annotation via code-scanning "
+                         "upload); the JSON report stays canonical")
     return ap
 
 
@@ -153,6 +176,7 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     findings: List = []
     combos: List[str] = []
     fingerprints: dict = {}
+    serve_variants: List[str] = []
     lint_files = 0
     try:
         if args.layer in ("all", "collectives"):
@@ -230,11 +254,30 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
                           f"dpt_plan artifact", file=sys.stderr)
                     return EXIT_INFRA
                 findings += check_plan_staleness(payload)
+            # serve contracts ride the collectives layer: the traced
+            # forwards must be collective-free (and under --hlo the
+            # compiled ones too), and every variant must lower
+            # donation-free through the engine's one jit wrapper
+            sfindings, serve_variants = collectives.analyze_serve(
+                hlo=args.hlo
+            )
+            findings += sfindings
+            from distributedpytorch_tpu.analysis import donation
+
+            dfindings, _dtags = donation.analyze_donation()
+            findings += dfindings
         if args.layer in ("all", "lint"):
             from distributedpytorch_tpu.analysis import lint
 
             lfindings, lint_files = lint.lint_package(args.lint_root)
             findings += lfindings
+        # the control-plane protocol explorer is jax-free and runs in
+        # milliseconds — EVERY layer selection gets it, so the elastic
+        # supervisor's collectives-layer preflight and the cold CI lint
+        # job both refuse a broken arbitration/rollout/fleet rule
+        from distributedpytorch_tpu.analysis import protocol
+
+        findings += protocol.analyze_protocols()
     except Exception as exc:  # noqa: BLE001 — infra failure, distinct rc
         print(f"analyze: infrastructure failure: {type(exc).__name__}: "
               f"{exc}", file=sys.stderr)
@@ -245,6 +288,8 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         "findings": [dataclasses.asdict(f) for f in findings],
         "combos": combos,
         "fingerprints": fingerprints,
+        "serve_variants": serve_variants,
+        "protocol": True,
         "lint_files": lint_files,
         "hlo": bool(args.hlo),
         "plan": args.plan,
@@ -256,8 +301,9 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
         print(f.line, file=out)
     print(
         f"analyze: {len(findings)} finding(s) over "
-        f"{len(combos)} combo(s) + {lint_files} linted file(s) in "
-        f"{report['duration_s']}s",
+        f"{len(combos)} combo(s) + {len(serve_variants)} serve "
+        f"variant trace(s) + {lint_files} linted file(s) + the "
+        f"protocol explorer in {report['duration_s']}s",
         file=out,
     )
     if args.json_path == "-":
@@ -266,6 +312,10 @@ def run(argv: Optional[Sequence[str]] = None) -> int:
     elif args.json_path:
         with open(args.json_path, "w") as f:
             json.dump(report, f, indent=2)
+    if args.sarif_path:
+        from distributedpytorch_tpu.analysis.sarif import write_sarif
+
+        write_sarif(args.sarif_path, findings)
     return EXIT_FINDINGS if findings else EXIT_CLEAN
 
 

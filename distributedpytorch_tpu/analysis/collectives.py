@@ -75,6 +75,7 @@ from distributedpytorch_tpu.analysis import (
 from distributedpytorch_tpu.parallel.mesh import (
     LEGACY_PATTERNS,
     channel_comms_required,
+    derive_eval_jaxpr_contract,
     derive_hlo_contract,
     derive_jaxpr_contract,
     is_mesh_spec,
@@ -173,6 +174,46 @@ def _build_contract_table() -> Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, 
 #: derives theirs on the fly from the parsed spec.
 JAXPR_CONTRACTS: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = (
     _build_contract_table()
+)
+
+
+def _derived_eval_contract(pattern, schedule) -> Tuple[JaxprComm, ...]:
+    """Eval-step rows from the rule engine, as JaxprComm requirements."""
+    return tuple(
+        JaxprComm(kind, axes, grad_output, why)
+        for kind, axes, grad_output, why in derive_eval_jaxpr_contract(
+            pattern, schedule
+        )
+    )
+
+
+def _build_eval_contract_table(
+) -> Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]]:
+    table: Dict[Tuple[str, Optional[str]], Tuple[JaxprComm, ...]] = {}
+    for method in ANALYSIS_STRATEGIES:
+        pattern = LEGACY_PATTERNS[method]
+        if pattern.is_pipeline:
+            for schedule in ANALYSIS_SCHEDULES:
+                table[(method, schedule)] = _derived_eval_contract(
+                    pattern, schedule
+                )
+        else:
+            table[(method, None)] = _derived_eval_contract(pattern, None)
+    return table
+
+
+#: Trace-level contract per (strategy, schedule) for the EVAL step,
+#: derived by the same rule engine (parallel/mesh.
+#: derive_eval_jaxpr_contract): the forward slice of the train program —
+#: inter-stage ppermutes, the in-stage param-reconstruction all_gathers,
+#: and the output-feeding eval-stats psum over 'stage' ONLY (stats are
+#: returned per data shard; no 'data' axis even on hybrids). Before this
+#: table, eval traces got structural checks but NO contract: a dropped
+#: eval psum shipped stage-local metrics as if they were global and no
+#: static gate noticed.
+EVAL_JAXPR_CONTRACTS: Dict[Tuple[str, Optional[str]],
+                           Tuple[JaxprComm, ...]] = (
+    _build_eval_contract_table()
 )
 
 
@@ -490,6 +531,158 @@ def trace_eval(method: str, schedule: Optional[str] = None):
     return jax.make_jaxpr(eval_step)(state.params, batch)
 
 
+# -- serve forwards ----------------------------------------------------------
+#: Every forward the serve engine AOT-compiles per bucket: plain f32,
+#: the ``--quantize int8`` weights-quantized path, the ``--kernels
+#: pallas`` fused sigmoid-threshold mask head, and their combination.
+#: All four must trace COLLECTIVE-FREE: serve replicas are independent
+#: (replicated or single-device), so any collective reaching a serve
+#: executable would block on peers that are serving other requests —
+#: a fleet-wide deadlock the first time that bucket is hit.
+SERVE_VARIANTS: Tuple[str, ...] = ("float", "int8", "pallas", "int8+pallas")
+
+#: Batch sizes traced per variant — the smallest and largest default
+#: bucket; the collective program must be bucket-size invariant.
+SERVE_TRACE_BATCHES: Tuple[int, ...] = (1, 8)
+
+
+def _abstract_quantized(params):
+    """The abstract image of ``ops/quant.quantize_tree`` over a params
+    tree of ShapeDtypeStructs. quantize_tree itself is host-side numpy
+    (it materializes scales), so it cannot run under tracing — this
+    mirrors its structure instead: every >=2-D float leaf becomes a
+    ``{q: int8[shape], scale: f32[1,...,1,C]}`` pair (per-out-channel
+    scales, keepdims), other float leaves stay f32. Must be kept in
+    lockstep with ``quantize_leaf``/``QUANT_KIND``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    def walk(node):
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if len(node.shape) >= 2 and np.issubdtype(node.dtype, np.floating):
+            scale_shape = (1,) * (len(node.shape) - 1) + (node.shape[-1],)
+            return {
+                "q": jax.ShapeDtypeStruct(node.shape, jnp.int8),
+                "scale": jax.ShapeDtypeStruct(scale_shape, jnp.float32),
+            }
+        if np.issubdtype(node.dtype, np.floating):
+            return jax.ShapeDtypeStruct(node.shape, jnp.float32)
+        return node
+
+    return walk(params)
+
+
+def _serve_rig(variant: str, batch: int):
+    """(forward_fn, abstract_variables, abstract_input) for one serve
+    variant — the exact function the engine jits per replica
+    (serve/infer.make_forward), over ShapeDtypeStructs only."""
+    import flax.serialization
+    import jax
+    import jax.numpy as jnp
+
+    from distributedpytorch_tpu.models.unet import UNet
+    from distributedpytorch_tpu.serve.infer import make_forward
+
+    if variant not in SERVE_VARIANTS:
+        raise ValueError(
+            f"unknown serve variant {variant!r}; expected one of "
+            f"{SERVE_VARIANTS}"
+        )
+    model = UNet(dtype=jnp.float32, widths=WIDTHS)
+    params = jax.eval_shape(
+        lambda k: model.init(k, jnp.zeros((1, H, W, 3)))["params"],
+        jax.random.key(0),
+    )
+    quantized = "int8" in variant
+    kw = {}
+    if quantized:
+        kw["quantized"] = True
+        params = _abstract_quantized(
+            flax.serialization.to_state_dict(params)
+        )
+    if "pallas" in variant:
+        kw["mask_threshold"] = 0.5
+    fwd = make_forward(model, **kw)
+    x = jax.ShapeDtypeStruct((batch, H, W, 3), jnp.float32)
+    return fwd, {"params": params}, x
+
+
+def trace_serve(variant: str, batch: int = 1):
+    """One serve variant's per-bucket forward as a ClosedJaxpr."""
+    import jax
+
+    fwd, variables, x = _serve_rig(variant, batch)
+    return jax.make_jaxpr(fwd)(variables, x)
+
+
+def check_serve_collective_free(
+    variants: Sequence[str] = SERVE_VARIANTS,
+) -> Tuple[List[Finding], List[str]]:
+    """Trace every serve variant at the smallest and largest default
+    bucket and require a collective-free program. Returns
+    ``(findings, tags)`` — one tag per traced (variant, bucket)."""
+    findings: List[Finding] = []
+    tags: List[str] = []
+    for variant in variants:
+        for batch in SERVE_TRACE_BATCHES:
+            where = f"serve {variant} forward (bucket {batch})"
+            tags.append(where)
+            colls = extract_collectives(trace_serve(variant, batch))
+            if colls:
+                kinds = sorted({c.kind for c in colls})
+                findings.append(Finding(
+                    rule="serve-collective",
+                    where=where,
+                    message=(
+                        f"{len(colls)} collective(s) ({', '.join(kinds)}) "
+                        f"leaked into a serve executable — serve replicas "
+                        f"are independent, so a collective blocks on peers "
+                        f"serving other requests and deadlocks the fleet "
+                        f"the first time this bucket is hit"
+                    ),
+                    layer="collectives",
+                ))
+    return dedupe(findings), tags
+
+
+def check_serve_hlo(variant: str, batch: int = 1) -> List[Finding]:
+    """The ``--hlo`` tier for serve: AOT-compile one variant's bucket
+    forward (GSPMD runs, nothing executes) and require the OPTIMIZED
+    HLO to be collective-free too — XLA must not have introduced one
+    behind the trace's back."""
+    import jax
+
+    fwd, variables, x = _serve_rig(variant, batch)
+    compiled = jax.jit(fwd).lower(variables, x).compile()
+    text = compiled.as_text()
+    ops = {name for name in _HLO_COLLECTIVE_NAMES if name in text}
+    if not ops:
+        return []
+    return [Finding(
+        rule="serve-collective-hlo",
+        where=f"serve {variant} forward (bucket {batch})",
+        message=(
+            f"optimized HLO contains {sorted(ops)} — the compiled serve "
+            f"executable communicates; replicas must compile to "
+            f"collective-free programs"
+        ),
+        layer="collectives",
+    )]
+
+
+def analyze_serve(variants: Sequence[str] = SERVE_VARIANTS,
+                  hlo: bool = False) -> Tuple[List[Finding], List[str]]:
+    """Every serve-variant check: trace-level collective-freedom, plus
+    the compiled-HLO tier when ``hlo``."""
+    findings, tags = check_serve_collective_free(variants)
+    if hlo:
+        for variant in variants:
+            findings += check_serve_hlo(variant)
+    return dedupe(findings), tags
+
+
 # -- checks ------------------------------------------------------------------
 def _combo_tag(method: str, schedule: Optional[str], kind: str) -> str:
     sched = f"/{schedule}" if schedule else ""
@@ -605,10 +798,29 @@ def _contract_requirements(
     return JAXPR_CONTRACTS.get(key, ())
 
 
+def _eval_contract_requirements(
+    method: str, schedule: Optional[str]
+) -> Tuple[JaxprComm, ...]:
+    """The EVAL-step comms contract for one method — same resolution
+    rule as :func:`_contract_requirements`, eval table/derivation."""
+    if is_mesh_spec(method):
+        cfg = parse_mesh_spec(method)
+        return _derived_eval_contract(
+            cfg, schedule if cfg.is_pipeline else None
+        )
+    key = (method, schedule if method in PIPELINE_STRATEGIES else None)
+    return EVAL_JAXPR_CONTRACTS.get(key, ())
+
+
 def check_contract(method: str, schedule: Optional[str], colls,
-                   where: str) -> List[Finding]:
+                   where: str, requirements=None) -> List[Finding]:
+    """Enforce a derived comms contract against an extracted collective
+    program. ``requirements`` defaults to the train-step contract;
+    ``analyze_combo`` passes the eval table for eval traces."""
     findings = []
-    for req in _contract_requirements(method, schedule):
+    if requirements is None:
+        requirements = _contract_requirements(method, schedule)
+    for req in requirements:
         candidates = [
             c for c in colls
             if c.kind == req.kind
@@ -616,8 +828,7 @@ def check_contract(method: str, schedule: Optional[str], colls,
             and req.axes <= {a for a in c.axes if isinstance(a, str)}
         ]
         if not candidates:
-            what = ("schedule-closing (output-feeding) " if req.grad_output
-                    else "")
+            what = "output-feeding " if req.grad_output else ""
             findings.append(Finding(
                 rule="comms-contract",
                 where=where,
@@ -1031,6 +1242,10 @@ def analyze_combo(method: str, schedule: Optional[str] = None,
     findings += check_axis_binding(eval_colls, where_e)
     findings += check_ppermute_flow(eval_colls, where_e)
     findings += check_uniform_branches(eval_colls, where_e)
+    findings += check_contract(
+        method, schedule, eval_colls, where_e,
+        requirements=_eval_contract_requirements(method, schedule),
+    )
 
     if rank_check:
         findings += check_rank_invariance(

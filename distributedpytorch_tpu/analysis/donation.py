@@ -1,0 +1,123 @@
+"""Layer 1c: donation-safety for the serving tier, statically.
+
+The donation bug class has bitten this codebase twice: the CPU-backend
+SIGABRT (a donated train-state buffer re-read after the step consumed
+it) and the AOT-store "Symbols not found" poisoning cousin (a shared
+executable whose operands a sibling process must be able to re-read).
+The AST lint's ``use-after-donation`` rule covers the train-step rebind
+idiom; this pass covers the SERVING side, where the invariant is
+stronger and simpler:
+
+**No serve executable may donate, ever.** Serve replicas re-read their
+weights operand on every request (``replica.variables`` is bound once
+per swap, called thousands of times), rollouts re-read snapshots taken
+BEFORE a swap (``snapshot_weights`` → ``restore_weights``), and
+AOT-store entries are rehydrated by sibling processes that share
+nothing with the compiling process but the bytes. A donated operand is
+freed by its first call — every one of those paths then reads poisoned
+memory.
+
+Three tiers enforce it:
+
+* **Intent** (:func:`check_serve_donation`, here) — every serve
+  variant (float / int8 / pallas / int8+pallas) is lowered through
+  ``serve/engine.serve_jit`` — the engine's ONE jit wrapper, so this
+  is the exact code path every bucket executable takes — and the
+  ``Lowered.donate_argnums`` record must be empty. This is
+  backend-independent: it fires even on the CPU analysis rig, where
+  XLA silently DROPS unusable donations at lowering (so a text scan
+  alone would miss the intent and the bug would wait for TPU to
+  materialize).
+* **Materialization** (also :func:`check_serve_donation`) — the
+  lowered module text must carry none of the aliasing markers
+  (``utils/aotstore.DONATION_MARKERS``) XLA stamps when a donation IS
+  usable. Lowering only; nothing compiles.
+* **Admission** (``utils/aotstore.AOTStore.save``) — the runtime
+  backstop: a compiled executable whose optimized HLO aliases an input
+  to an output is refused store admission with a pointed log line, so
+  even a donation introduced past the static gates cannot poison
+  sibling processes through the store.
+
+The AST companion rule (``analysis/lint.py`` ``serve-donation``) flags
+any ``jit(..., donate_argnums=...)`` call that appears in a serve
+module at the source level — catching wrappers that never reach the
+engine's lowering path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+from distributedpytorch_tpu.analysis import Finding, dedupe
+from distributedpytorch_tpu.analysis.collectives import (
+    SERVE_VARIANTS,
+    _serve_rig,
+)
+from distributedpytorch_tpu.utils.aotstore import DONATION_MARKERS
+
+
+def lower_serve_variant(variant: str, batch: int = 1):
+    """One serve variant's forward, LOWERED through the exact wrapper
+    the engine compiles with (``serve/engine.serve_jit``) — abstract
+    inputs, no compile, no device execution."""
+    from distributedpytorch_tpu.serve.engine import serve_jit
+
+    fwd, variables, x = _serve_rig(variant, batch)
+    return serve_jit(fwd).lower(variables, x)
+
+
+def check_serve_donation(
+    variants: Sequence[str] = SERVE_VARIANTS,
+) -> Tuple[List[Finding], List[str]]:
+    """Lower every serve variant through ``serve_jit`` and require it
+    donation-free at both the intent and the materialization tier.
+    Returns ``(findings, tags)``."""
+    findings: List[Finding] = []
+    tags: List[str] = []
+    for variant in variants:
+        where = f"serve {variant} forward (lowered)"
+        tags.append(where)
+        lowered = lower_serve_variant(variant)
+        donated = tuple(getattr(lowered, "donate_argnums", ()) or ())
+        if donated:
+            findings.append(Finding(
+                rule="serve-donation",
+                where=where,
+                message=(
+                    f"serve executable lowers with donated argument(s) "
+                    f"{donated} — replicas re-read their weights operand "
+                    f"on every request and AOT-store siblings rehydrate "
+                    f"them, so the donated buffer is freed after the "
+                    f"first call and every later read is poisoned (the "
+                    f"CPU donation SIGABRT class); serve_jit must never "
+                    f"donate"
+                ),
+                layer="donation",
+            ))
+            continue
+        text = lowered.as_text()
+        marked = [m for m in DONATION_MARKERS if m in text]
+        if marked:
+            findings.append(Finding(
+                rule="serve-donation",
+                where=where,
+                message=(
+                    f"lowered serve module carries aliasing marker(s) "
+                    f"{marked} — an input buffer is aliased into an "
+                    f"output, so the executable consumes an operand the "
+                    f"serving tier re-reads (swap snapshots, store "
+                    f"rehydration); serve executables must lower "
+                    f"alias-free"
+                ),
+                layer="donation",
+            ))
+    return dedupe(findings), tags
+
+
+def analyze_donation(
+    variants: Sequence[str] = SERVE_VARIANTS,
+) -> Tuple[List[Finding], List[str]]:
+    """The donation pass: every serve variant, lowering tier only (the
+    admission guard runs at store-save time; the AST rule runs with the
+    lint layer)."""
+    return check_serve_donation(variants)

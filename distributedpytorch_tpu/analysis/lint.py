@@ -75,8 +75,24 @@ with rationale and what each provably excludes: docs/ANALYSIS.md):
   once at trace time and record nothing (or bake a host side effect
   into the compiled program).
 
+* ``serve-donation`` — a ``jit(..., donate_argnums=...)`` (or
+  ``donate_argnames``) call inside a serve module. Serve executables
+  re-read their weights operand on every request, rollbacks re-read
+  pre-swap snapshots, and AOT-store siblings rehydrate shared buffers —
+  donation anywhere in the serving tier is a use-after-free waiting for
+  a backend that honors it (the CPU donation SIGABRT class). The
+  engine's one sanctioned wrapper is ``serve/engine.serve_jit``, which
+  never donates; the jaxpr tier (analysis/donation.py) proves the
+  lowered executables clean, this rule points at the source line of
+  any wrapper that would bypass it.
+
 Suppression: append ``# dptlint: disable=<rule>[,<rule>...]`` (or
 ``disable=all``) to the offending line, with a justification.
+Suppressions are themselves linted: naming a rule this linter does not
+define is an ``unknown-suppression`` finding (likely a typo silently
+suppressing nothing), and suppressing a rule that no longer fires on
+that line is a ``stale-suppression`` finding — dead suppressions hide
+future regressions on the lines they squat on.
 """
 
 from __future__ import annotations
@@ -233,6 +249,11 @@ def _is_obs_module(rel_path: str) -> bool:
     return "/obs/" in sep or sep.startswith("obs/")
 
 
+def _is_serve_module(rel_path: str) -> bool:
+    sep = rel_path.replace("\\", "/")
+    return "/serve/" in sep or sep.startswith("serve/")
+
+
 def _is_obs_record_fn(name: str) -> bool:
     return name.startswith(("record", "mark")) or name in OBS_RECORD_FN_NAMES
 
@@ -266,6 +287,15 @@ def _bounded_append_targets(tree: ast.AST) -> Set[str]:
 def _donating_call(terminal: str) -> bool:
     return terminal in DONATING_CALLS
 
+
+#: Every rule this linter can emit — the vocabulary a ``dptlint:
+#: disable=`` comment may name. A suppression outside this set is a
+#: typo that suppresses nothing (rule ``unknown-suppression``).
+KNOWN_RULES = frozenset({
+    "parse-error", "trace-nondeterminism", "host-sync-hot-path",
+    "serve-hot-path", "use-after-donation", "rank-gated-collective",
+    "dtype-policy", "ckpt-dtype-drift", "obs-hot-path", "serve-donation",
+})
 
 _SUPPRESS_RE = re.compile(
     r"#\s*dptlint:\s*disable=([\w\-]+(?:\s*,\s*[\w\-]+)*)"
@@ -436,11 +466,15 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
     index(tree, None)  # type: ignore[arg-type]
 
     findings: List[Finding] = []
+    # (line, rule-name) pairs a suppression actually absorbed — the
+    # complement at the end is the stale-suppression report
+    used_suppressions: Set[Tuple[int, str]] = set()
 
     def emit(rule: str, node: ast.AST, message: str):
         line = getattr(node, "lineno", 0)
         rules = suppressed.get(line, set())
         if rule in rules or "all" in rules:
+            used_suppressions.add((line, rule if rule in rules else "all"))
             return
         findings.append(Finding(
             rule=rule, where=f"{rel_path}:{line}", message=message,
@@ -448,6 +482,7 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
         ))
 
     in_obs_module = _is_obs_module(rel_path)
+    in_serve_module = _is_serve_module(rel_path)
     dtype_sanctioned_file = any(
         rel_path.endswith(sfx) for sfx in DTYPE_POLICY_SANCTIONED_MODULES
     )
@@ -597,6 +632,25 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                     "contract (precision.LOSS_DTYPE / WGRAD_DTYPE / "
                     "REDUCE_DTYPE) or thread the policy",
                 )
+
+        # -- serve-donation: a donating jit wrapper anywhere in the
+        # serving tier — serve executables re-read every operand
+        # (request path, swap snapshots, store rehydration), so a
+        # donated buffer is a use-after-free on any backend that
+        # honors it; the one sanctioned wrapper (engine.serve_jit)
+        # never donates
+        if in_serve_module and term == "jit" and any(
+            kw.arg in ("donate_argnums", "donate_argnames")
+            for kw in node.keywords
+        ):
+            emit(
+                "serve-donation", node,
+                "`jit(..., donate_*)` in a serve module: serve "
+                "executables re-read their operands (every request, "
+                "rollback snapshots, AOT-store rehydration), so a "
+                "donated buffer is freed under a future read — lower "
+                "through serve/engine.serve_jit, which never donates",
+            )
 
         # -- obs-hot-path (b): telemetry calls inside traced functions
         # execute ONCE at trace time — the metric/event silently never
@@ -776,6 +830,38 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
                         f"collective on every rank (gate only the use of "
                         f"its result)",
                     )
+
+    # -- suppression hygiene: every `dptlint: disable=` comment must
+    # name a real rule AND still absorb a finding on its line. A typo'd
+    # rule suppresses nothing (silently); a suppression whose rule no
+    # longer fires is dead weight that would hide the NEXT regression
+    # landing on that line.
+    for line, rules in sorted(suppressed.items()):
+        for rule in sorted(rules):
+            if rule != "all" and rule not in KNOWN_RULES:
+                findings.append(Finding(
+                    rule="unknown-suppression",
+                    where=f"{rel_path}:{line}",
+                    message=(
+                        f"suppression names unknown rule {rule!r} — not "
+                        f"one of this linter's rules, so it suppresses "
+                        f"nothing (typo?); known: "
+                        f"{', '.join(sorted(KNOWN_RULES))}, all"
+                    ),
+                    layer="lint",
+                ))
+            elif (line, rule) not in used_suppressions:
+                findings.append(Finding(
+                    rule="stale-suppression",
+                    where=f"{rel_path}:{line}",
+                    message=(
+                        f"suppression of {rule!r} is stale: the rule no "
+                        f"longer fires on this line — remove the comment "
+                        f"(a dead suppression hides the next regression "
+                        f"that lands here)"
+                    ),
+                    layer="lint",
+                ))
 
     return findings
 

@@ -1,0 +1,86 @@
+"""SARIF 2.1.0 output for ``analyze --sarif`` — CI PR annotation.
+
+GitHub's code-scanning upload turns a SARIF artifact into inline PR
+annotations on the exact lines, which is how dptlint findings reach a
+reviewer without anyone opening the job log. The JSON report
+(``--json``) stays canonical — richer, stable, and what the launch
+preflights parse; this module is a one-way projection of the same
+findings into the interchange shape.
+
+Only findings whose ``where`` is a real ``path:line`` (the AST lint
+layer) get a ``physicalLocation`` — jaxpr/protocol findings are
+program-level (a combo tag like ``"MP/1f1b eval step"``, not a file)
+and are emitted as location-free results with the combo named in the
+message, which SARIF viewers list at run scope. Pure stdlib; safe for
+jax-free callers.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import List, Sequence
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+#: ``where`` values that point at source: ``path/to/file.py:123``.
+_FILE_WHERE_RE = re.compile(r"^(?P<path>[^:\s]+\.py):(?P<line>\d+)$")
+
+
+def to_sarif(findings: Sequence) -> dict:
+    """Project a findings list into a single-run SARIF 2.1.0 log."""
+    rules: List[dict] = []
+    seen_rules = {}
+    results: List[dict] = []
+    for f in findings:
+        if f.rule not in seen_rules:
+            seen_rules[f.rule] = len(rules)
+            rules.append({
+                "id": f.rule,
+                "shortDescription": {"text": f.rule},
+                "properties": {"layer": f.layer},
+            })
+        m = _FILE_WHERE_RE.match(f.where)
+        result = {
+            "ruleId": f.rule,
+            "ruleIndex": seen_rules[f.rule],
+            "level": "error",
+            "message": {
+                "text": f.message if m else f"[{f.where}] {f.message}"
+            },
+        }
+        if m:
+            result["locations"] = [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": m.group("path").replace("\\", "/"),
+                    },
+                    "region": {"startLine": int(m.group("line"))},
+                },
+            }]
+        results.append(result)
+    return {
+        "version": SARIF_VERSION,
+        "$schema": SARIF_SCHEMA,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dptlint",
+                    "informationUri":
+                        "https://github.com/notnitsuj/DistributedPyTorch",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: Sequence) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_sarif(findings), f, indent=2)
+        f.write("\n")

@@ -98,6 +98,7 @@ from typing import Dict, List, Optional, Sequence
 from distributedpytorch_tpu.dist import health
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.serve import control
 
 logger = logging.getLogger(__name__)
 
@@ -858,10 +859,12 @@ class ElasticSupervisor:
         siblings compiled: ``recompiles: 0``) — then waits for
         ``/healthz`` ready and admits the worker to every router.
         Returns the rank, or None if the spawn failed."""
-        if self._retired_ranks:
-            rank = min(self._retired_ranks)
-        else:
-            rank = len(self._procs)
+        # the rank choice is the pure rule the protocol explorer
+        # model-checks (serve/control.fleet_spawn_rank): lowest retired
+        # slot reused, else a fresh appended rank
+        rank = control.fleet_spawn_rank(
+            self.active_serve_ranks(), frozenset(self._retired_ranks)
+        )
         logger.info("elastic fleet: spawning worker %d (port %d)",
                     rank, self.base_port + rank)
         log_f = open(self._log_path(0, rank), "ab")
@@ -930,10 +933,13 @@ class ElasticSupervisor:
         SIGTERM (serve/cli.py drains its own queue on it), grace,
         SIGKILL stragglers. Returns the rank, or None if there is
         nothing retireable."""
-        active = self.active_serve_ranks()
-        if len(active) <= 1:
+        # rank choice + the never-below-one refusal are the pure rule
+        # the protocol explorer model-checks (control.fleet_retire_rank);
+        # the actuation below follows control.FLEET_RETIRE_ORDER —
+        # routers stop placing BEFORE the process dies
+        rank = control.fleet_retire_rank(self.active_serve_ranks())
+        if rank is None:
             return None
-        rank = max(active)
         address = f"{self._worker_host()}:{self.base_port + rank}"
         logger.info("elastic fleet: retiring worker %d (%s)",
                     rank, address)

@@ -363,6 +363,44 @@ def derive_jaxpr_contract(
     return tuple(rows)
 
 
+def derive_eval_jaxpr_contract(
+    cfg: MeshConfig, schedule: Optional[str]
+) -> Tuple[Tuple[str, frozenset, bool, str], ...]:
+    """The trace-level comms contract a config's EVAL step must
+    satisfy — same row shape as :func:`derive_jaxpr_contract`, derived
+    from the same sharding rules. The eval program is the train
+    program's forward slice: the inter-stage activation ppermutes and
+    the in-stage param-reconstruction all_gathers survive, the
+    loss-stats reduction becomes an output-feeding psum over 'stage'
+    ONLY (eval stats are reduced across stages but returned per data
+    shard — the host averages shards, so no 'data' axis appears even on
+    hybrids), and the 1f1b gradient row vanishes with the backward
+    pass (eval runs the gpipe-shaped forward under either schedule).
+    GSPMD configs stay empty here, same as train."""
+    if not cfg.is_pipeline:
+        return ()
+    rows: List[Tuple[str, frozenset, bool, str]] = [
+        ("ppermute", frozenset({"stage"}), False,
+         "inter-stage activation transfers (eval forward)"),
+        ("psum", frozenset({"stage"}), True,
+         "output-feeding eval loss/accuracy-stats reduction across "
+         "stages — dropping it ships stage-local metrics as if global"),
+    ]
+    if cfg.model > 1 and cfg.model_role == "channel":
+        rows.append((
+            "all_gather", frozenset({"model"}), False,
+            "in-stage channel-TP param reconstruction (eval forward "
+            "gathers at use, same as train)",
+        ))
+    if "fsdp" in cfg.params and cfg.data > 1:
+        rows.append((
+            "all_gather", frozenset({"data"}), False,
+            "in-stage ZeRO param reconstruction over the data axis "
+            "(eval forward gathers at use, same as train)",
+        ))
+    return tuple(rows)
+
+
 def channel_comms_required(cfg: MeshConfig) -> bool:
     """Does this config carry a channel-sharded model axis? Its HLO
     must then show SOME channel collective — XLA picks the mechanism

@@ -393,6 +393,17 @@ def _reduce_grads(grads, axes):
     return jax.lax.psum(grads, axes)
 
 
+def _broadcast_preds(preds, stage_axis):
+    """Replicate inference output across the stage axis: the last stage
+    holds the real predictions, the rest hold zeros, so the psum is a
+    broadcast-from-last-stage (the reference's ``.to('cuda:0')`` gather).
+    A named seam (same discipline as ``_reduce_grads``) so the static
+    analyzer's mutation tests can drop the eval reduction and prove the
+    derived EVAL contract catches stage-local metrics shipping as
+    global."""
+    return jax.lax.psum(preds, stage_axis)
+
+
 def _in_stage_config(mesh: Mesh, mesh_config):
     """Gate for in-stage sharding: returns the mesh config when its
     params rule actually shards leaves over an axis this mesh carries
@@ -985,9 +996,7 @@ def make_pipeline_forward_fn(
             bn_state=bn,
         )
         out = jnp.concatenate(preds, axis=0)
-        # Replicate across the stage axis: the last stage holds the real
-        # output, the rest hold zeros → psum is a broadcast-from-last-stage.
-        return jax.lax.psum(out, stage_axis)
+        return _broadcast_preds(out, stage_axis)
 
     if in_stage is None:
         return shard_map(

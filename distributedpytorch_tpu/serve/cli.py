@@ -407,9 +407,7 @@ def make_http_server(server, host: str = "127.0.0.1", port: int = 0,
         STATUS_SHUTDOWN,
     )
 
-    # make_http_server builds an HTTP handler class, not a jitted fn —
-    # the make_* trace heuristic doesn't apply to this host-only module
-    started_t = time.monotonic()  # dptlint: disable=trace-nondeterminism
+    started_t = time.monotonic()
     fingerprint = build_fingerprint(getattr(server, "config", None))
 
     class Handler(BaseHTTPRequestHandler):

@@ -1,4 +1,5 @@
-"""The scaling control law, shared by both capacity actuators.
+"""The serve control plane's pure decision laws, shared by the live
+actuators AND the static protocol explorer.
 
 Two actuators move serve capacity, at different granularities:
 
@@ -18,6 +19,25 @@ clamp, pin-hold, cooldown, direction), and the plan citation
 (:func:`plan_point_for` — observed rate → nearest simulated poisson
 scenario at or above it → grid point key at the base knobs).
 
+Beyond scaling, this module now holds EVERY control-plane transition
+rule the fleet's protocols rest on, extracted pure (the plan-serve
+pattern that produced :func:`decide_scale`):
+
+* :func:`decide_ha` — the router active/standby epoch arbitration
+  (serve/router.py ``ha_once`` consumes it verbatim);
+* :func:`rollout_transition` / :func:`ab_may_start` — the rollout
+  canary state machine and the one-experiment-at-a-time guard
+  (serve/rollout.py consumes them verbatim);
+* :func:`scale_hold_reason` — why a scaler must hold while replica
+  groups are pinned (serve/scaler.py consumes it verbatim);
+* :func:`fleet_spawn_rank` / :func:`fleet_retire_rank` — the fleet
+  grow/shrink rank selection (dist/elastic.py consumes them verbatim).
+
+Because the live code calls these exact functions, the explicit-state
+model checker in ``analysis/protocol.py`` explores the SAME transition
+rules the fleet executes — a mutated comparison here (or in a consumer
+that stops calling the seam) is a static finding, not a 3 a.m. outage.
+
 Deliberately jax-free: the fleet actuator runs inside the supervisor
 process, which never initializes a device runtime. Anything that needs
 a backend (the replica scaler's default device cap) stays in the
@@ -27,7 +47,7 @@ caller.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import FrozenSet, Optional, Sequence, Tuple
 
 DIR_UP = "up"
 DIR_DOWN = "down"
@@ -159,3 +179,203 @@ def decide_scale(
         direction, current, target,
         f"hint {recommendation} vs live {current}",
         plan_point, plan_replicas, rate_rps)
+
+
+def scale_hold_reason(*, ab_pinned: bool,
+                      versions_mixed: bool) -> Optional[str]:
+    """Why a capacity actuator must HOLD regardless of the load hint:
+    replica groups pinned by a sustained A/B, or weight versions mixed
+    (a rollout canary in flight — resizing would retire or spawn groups
+    out from under the experiment). None = free to act."""
+    if ab_pinned:
+        return "replica groups pinned by a sustained A/B"
+    if versions_mixed:
+        return "weight versions mixed (rollout in flight)"
+    return None
+
+
+# -- router active/standby HA arbitration ------------------------------------
+HA_TAKE_OVER = "take_over"
+HA_DEMOTE = "demote"
+HA_SYNC = "sync"
+HA_HOLD = "hold"
+
+
+@dataclasses.dataclass(frozen=True)
+class HaDecision:
+    """One HA-exchange verdict: what to do, the epoch this router holds
+    AFTER doing it, and the reason the logs/flight ring stamp."""
+
+    action: str                 # take_over | demote | sync | hold
+    epoch: int
+    reason: str
+
+
+def takeover_epoch(epoch: int, peer_epoch_seen: int) -> int:
+    """The fencing rule: a takeover must claim an epoch STRICTLY above
+    every epoch this router has ever held or seen its peer hold, so a
+    relaunched ex-active (epoch reset to 0) can never outrank the
+    router that took over from it."""
+    return max(int(epoch), int(peer_epoch_seen)) + 1
+
+
+def decide_ha(
+    *,
+    role: str,
+    epoch: int,
+    primary: bool,
+    peer_epoch_seen: int,
+    peer_reachable: bool,
+    peer_role: Optional[str] = None,
+    peer_epoch: int = 0,
+) -> HaDecision:
+    """One router's HA-exchange decision, pure. Mirrors the prose
+    contract in serve/router.py: standby + dead active → take over on
+    THIS missed probe; both active → the higher epoch keeps the role,
+    the born-active primary wins ties; both standby → the primary
+    promotes; standby + reachable active → pull its snapshot and adopt
+    its epoch. ``peer_epoch_seen`` is the highest epoch the peer has
+    EVER shown this router (before folding in this probe's
+    ``peer_epoch``)."""
+    if not peer_reachable:
+        if role == "standby":
+            return HaDecision(
+                HA_TAKE_OVER, takeover_epoch(epoch, peer_epoch_seen),
+                "active router missed a probe",
+            )
+        return HaDecision(HA_HOLD, int(epoch),
+                          "peer unreachable; already active")
+    seen = max(int(peer_epoch_seen), int(peer_epoch))
+    if role == "active" and peer_role == "active":
+        if peer_epoch > epoch or (peer_epoch == epoch and not primary):
+            return HaDecision(HA_DEMOTE, max(int(epoch), int(peer_epoch)),
+                              "peer is active at a higher epoch")
+        return HaDecision(HA_HOLD, int(epoch),
+                          "dual-active: this router's epoch wins")
+    if role == "standby" and peer_role == "standby":
+        if primary:
+            return HaDecision(
+                HA_TAKE_OVER, takeover_epoch(epoch, seen),
+                "both routers standby; primary promotes",
+            )
+        return HaDecision(HA_HOLD, int(epoch),
+                          "both standby; waiting for the primary")
+    if role == "standby":
+        return HaDecision(HA_SYNC, max(int(epoch), int(peer_epoch)),
+                          "pulling the active peer's snapshot")
+    return HaDecision(HA_HOLD, int(epoch), "active with a standby peer")
+
+
+# -- rollout canary state machine --------------------------------------------
+#: The canonical state/outcome names (serve/rollout.py re-exports them;
+#: they appear verbatim in /admin/rollout payloads and the flight ring).
+ROLLOUT_IDLE = "idle"
+ROLLOUT_LOADING = "loading"
+ROLLOUT_CANARY = "canary"
+ROLLOUT_PROMOTING = "promoting"
+
+ROLLOUT_PROMOTED = "promoted"
+ROLLOUT_ROLLED_BACK = "rolled_back"
+ROLLOUT_SWAP_FAILED = "swap_failed"
+ROLLOUT_LOAD_FAILED = "load_failed"
+
+#: Which snapshot a transition must restore before it completes:
+#: ``canary`` = only the canary groups (the rest never swapped),
+#: ``all`` = every group (a promote-time crash must not leave the fleet
+#: split across versions as the steady state).
+RESTORE_NONE = "none"
+RESTORE_CANARY = "canary"
+RESTORE_ALL = "all"
+
+
+@dataclasses.dataclass(frozen=True)
+class RolloutStep:
+    """One legal rollout transition: the next state, the terminal
+    outcome (when the next state is idle), and the restore scope the
+    transition is REQUIRED to apply before finishing."""
+
+    state: str
+    outcome: Optional[str]
+    restore: str
+
+
+_ROLLOUT_TABLE = {
+    (ROLLOUT_IDLE, "start"):
+        RolloutStep(ROLLOUT_LOADING, None, RESTORE_NONE),
+    (ROLLOUT_LOADING, "load_ok"):
+        RolloutStep(ROLLOUT_CANARY, None, RESTORE_NONE),
+    (ROLLOUT_LOADING, "load_failed"):
+        RolloutStep(ROLLOUT_IDLE, ROLLOUT_LOAD_FAILED, RESTORE_NONE),
+    (ROLLOUT_CANARY, "swap_failed"):
+        RolloutStep(ROLLOUT_IDLE, ROLLOUT_SWAP_FAILED, RESTORE_CANARY),
+    (ROLLOUT_CANARY, "judge_fail"):
+        RolloutStep(ROLLOUT_IDLE, ROLLOUT_ROLLED_BACK, RESTORE_CANARY),
+    (ROLLOUT_CANARY, "judge_pass"):
+        RolloutStep(ROLLOUT_PROMOTING, None, RESTORE_NONE),
+    (ROLLOUT_PROMOTING, "swap_failed"):
+        RolloutStep(ROLLOUT_IDLE, ROLLOUT_SWAP_FAILED, RESTORE_ALL),
+    (ROLLOUT_PROMOTING, "swap_ok"):
+        RolloutStep(ROLLOUT_IDLE, ROLLOUT_PROMOTED, RESTORE_NONE),
+}
+
+#: Events the explorer enumerates per state (table key view).
+ROLLOUT_EVENTS = tuple(sorted({e for _s, e in _ROLLOUT_TABLE}))
+
+
+def rollout_transition(state: str, event: str) -> RolloutStep:
+    """The rollout state machine, pure. Raises ``ValueError`` on an
+    illegal (state, event) pair — the live manager only ever takes legal
+    edges, and the model checker treats an illegal edge it can reach as
+    a finding."""
+    try:
+        return _ROLLOUT_TABLE[(state, event)]
+    except KeyError:
+        raise ValueError(
+            f"illegal rollout transition: event {event!r} in state "
+            f"{state!r}"
+        ) from None
+
+
+def ab_may_start(*, rollout_state: str,
+                 replica_groups: int) -> Optional[str]:
+    """The one-experiment-at-a-time guard, pure: None = a sustained A/B
+    may start; otherwise the refusal reason. A canaried rollout owns
+    the replica groups (pinning arms under it would judge the canary
+    against a moving fleet), and disjoint arms need two groups."""
+    if rollout_state in (ROLLOUT_CANARY, ROLLOUT_PROMOTING):
+        return ("a canaried rollout is in flight — one experiment owns "
+                "the replica groups at a time")
+    if int(replica_groups) < 2:
+        return (f"sustained A/B needs >= 2 replica groups to pin "
+                f"disjoint arms (have {replica_groups}) — scale up first")
+    return None
+
+
+# -- fleet grow/shrink rank selection ----------------------------------------
+def fleet_spawn_rank(active_ranks: Sequence[int],
+                     retired_ranks: FrozenSet[int]) -> int:
+    """Which rank slot a fleet grow claims: the LOWEST retired slot
+    (its port base+R and heartbeat slot come back with it) or a fresh
+    appended rank. Pure — dist/elastic.py's ``spawn_fleet_worker``
+    actuates exactly this choice."""
+    if retired_ranks:
+        return min(retired_ranks)
+    return len(active_ranks) + len(retired_ranks)
+
+
+def fleet_retire_rank(active_ranks: Sequence[int]) -> Optional[int]:
+    """Which rank a fleet shrink retires: the HIGHEST active rank, or
+    None when only one worker remains (a scale-down must never take the
+    fleet to zero). Pure — dist/elastic.py's ``retire_fleet_worker``
+    actuates exactly this choice."""
+    ranks = sorted(int(r) for r in active_ranks)
+    if len(ranks) <= 1:
+        return None
+    return ranks[-1]
+
+
+#: The retire actuation ORDER the supervisor must follow — routers stop
+#: placing onto the worker BEFORE its process dies, and in-flight
+#: requests drain between the two; any other order is a lost-request
+#: window the protocol explorer rejects.
+FLEET_RETIRE_ORDER = ("eject_from_routers", "drain_inflight", "sigterm")

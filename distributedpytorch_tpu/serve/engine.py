@@ -48,6 +48,23 @@ from distributedpytorch_tpu.serve.infer import (
 logger = logging.getLogger(__name__)
 
 
+def serve_jit(fn):
+    """The engine's ONE jit wrapper: every serve executable — every
+    bucket of every replica, and therefore every entry admitted to the
+    AOT store — lowers through here. It must NEVER donate: serve
+    executables re-read their weights operand on every request, and a
+    store-shared executable additionally re-reads buffers that sibling
+    processes rehydrate — a donated operand is freed after the first
+    call and the next request reads poisoned memory (the CPU-backend
+    SIGABRT class). Kept as a named module-level seam so the donation
+    pass (analysis/donation.py) can lower THROUGH the exact wrapper the
+    engine uses, and its mutation tests can donate here and prove the
+    pass catches it."""
+    import jax
+
+    return jax.jit(fn)
+
+
 @dataclasses.dataclass
 class Replica:
     """One device's serving state: weights resident on ``device`` and one
@@ -178,7 +195,7 @@ class ServeEngine:
         sharding = SingleDeviceSharding(device)
         vars_dev = jax.device_put(variables, sharding)
         h, w = self.input_hw
-        jitted = jax.jit(self._fwd)
+        jitted = serve_jit(self._fwd)
         compiled: Dict[int, object] = {}
         for b in self.planner.sizes:
             x_sds = jax.ShapeDtypeStruct(

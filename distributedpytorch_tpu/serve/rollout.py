@@ -51,18 +51,23 @@ import numpy as np
 
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.serve import control
 
 logger = logging.getLogger(__name__)
 
-STATE_IDLE = "idle"
-STATE_LOADING = "loading"
-STATE_CANARY = "canary"
-STATE_PROMOTING = "promoting"
+# The state machine itself — names, legal transitions, and the restore
+# scope each failure edge must apply — is the PURE table in
+# serve/control.py (rollout_transition), which analysis/protocol.py
+# model-checks; this module is its actuator.
+STATE_IDLE = control.ROLLOUT_IDLE
+STATE_LOADING = control.ROLLOUT_LOADING
+STATE_CANARY = control.ROLLOUT_CANARY
+STATE_PROMOTING = control.ROLLOUT_PROMOTING
 
-OUTCOME_PROMOTED = "promoted"
-OUTCOME_ROLLED_BACK = "rolled_back"
-OUTCOME_SWAP_FAILED = "swap_failed"
-OUTCOME_LOAD_FAILED = "load_failed"
+OUTCOME_PROMOTED = control.ROLLOUT_PROMOTED
+OUTCOME_ROLLED_BACK = control.ROLLOUT_ROLLED_BACK
+OUTCOME_SWAP_FAILED = control.ROLLOUT_SWAP_FAILED
+OUTCOME_LOAD_FAILED = control.ROLLOUT_LOAD_FAILED
 
 
 class RolloutInProgress(RuntimeError):
@@ -245,15 +250,29 @@ class RolloutManager:
         self._transition(STATE_IDLE, outcome=outcome, reason=reason,
                          **fields)
 
+    def _apply_restore(self, step: "control.RolloutStep",
+                       old: Dict[int, tuple],
+                       canary_idx: Sequence[int]) -> None:
+        """Apply the restore scope the pure transition table REQUIRES of
+        this edge (control.RolloutStep.restore): the canary subset when
+        the rest never swapped, the whole snapshot when a promote-time
+        crash could leave the fleet split across versions."""
+        if step.restore == control.RESTORE_CANARY:
+            self.engine.restore_weights({i: old[i] for i in canary_idx})
+        elif step.restore == control.RESTORE_ALL:
+            self.engine.restore_weights(old)
+
     def _run(self, source, label: str) -> None:
         self._compiles_at_start = getattr(self.engine, "aot_compiles", 0)
-        self._transition(STATE_LOADING, label=label)
+        step = control.rollout_transition(self._state, "start")
+        self._transition(step.state, label=label)
         try:
             params, model_state = self._load(source)
         except BaseException as exc:  # noqa: BLE001 — a bad candidate is
             # a verdict, never a crash of the serving process
             logger.exception("rollout: candidate failed to load")
-            self._finish(OUTCOME_LOAD_FAILED, reason=str(exc)[:300])
+            step = control.rollout_transition(self._state, "load_failed")
+            self._finish(step.outcome, reason=str(exc)[:300])
             return
 
         n = self.engine.num_replicas
@@ -276,7 +295,8 @@ class RolloutManager:
                 baseline_dice = self._probe_dice(canary_idx[0], refs)
 
         obsm.SERVE_ROLLOUT_CANARY.set(1)
-        self._transition(STATE_CANARY, version=version, label=label,
+        step = control.rollout_transition(self._state, "load_ok")
+        self._transition(step.state, version=version, label=label,
                          canary_replicas=len(canary_idx))
         try:
             self.engine.swap_weights(params, model_state, version=version,
@@ -285,8 +305,9 @@ class RolloutManager:
             # real device_put failures: partially-swapped canaries
             # restore, the old version never stopped serving
             logger.exception("rollout: canary swap failed")
-            self.engine.restore_weights({i: old[i] for i in canary_idx})
-            self._finish(OUTCOME_SWAP_FAILED, reason=str(exc)[:300],
+            step = control.rollout_transition(self._state, "swap_failed")
+            self._apply_restore(step, old, canary_idx)
+            self._finish(step.outcome, reason=str(exc)[:300],
                          version=version)
             return
 
@@ -300,12 +321,14 @@ class RolloutManager:
         if self._stop.is_set() and reason is None:
             reason = "rollout aborted (stop requested)"
         if reason is not None:
-            self.engine.restore_weights({i: old[i] for i in canary_idx})
-            self._finish(OUTCOME_ROLLED_BACK, reason=reason,
+            step = control.rollout_transition(self._state, "judge_fail")
+            self._apply_restore(step, old, canary_idx)
+            self._finish(step.outcome, reason=reason,
                          version=version)
             return
 
-        self._transition(STATE_PROMOTING, version=version)
+        step = control.rollout_transition(self._state, "judge_pass")
+        self._transition(step.state, version=version)
         try:
             if rest_idx:
                 self.engine.swap_weights(params, model_state,
@@ -315,13 +338,15 @@ class RolloutManager:
             # crash rolls EVERYTHING back: a fleet split across versions
             # must never be the steady state
             logger.exception("rollout: promote swap failed — rolling back")
-            self.engine.restore_weights(old)
-            self._finish(OUTCOME_SWAP_FAILED,
+            step = control.rollout_transition(self._state, "swap_failed")
+            self._apply_restore(step, old, canary_idx)
+            self._finish(step.outcome,
                          reason=f"promote failed: {str(exc)[:250]}",
                          version=version)
             return
+        step = control.rollout_transition(self._state, "swap_ok")
         obsm.SERVE_WEIGHTS_VERSION.set(version)
-        self._finish(OUTCOME_PROMOTED, version=version, label=label)
+        self._finish(step.outcome, version=version, label=label)
 
     def _judge(self, base: dict, canary_replica: int,
                refs: Optional[Sequence[np.ndarray]],
@@ -490,17 +515,18 @@ class ABTest:
             if self.active:
                 raise RolloutInProgress("an A/B test is already running")
             rollout = getattr(self.server, "rollout", None)
-            if rollout is not None and rollout.canarying:
-                raise RolloutInProgress(
-                    "a canaried rollout is in flight — one experiment "
-                    "owns the replica groups at a time"
-                )
             n = self.engine.num_replicas
-            if n < 2:
-                raise ValueError(
-                    f"sustained A/B needs >= 2 replica groups to pin "
-                    f"disjoint arms (have {n}) — scale up first"
-                )
+            # the one-experiment-at-a-time guard is the pure rule the
+            # protocol explorer model-checks (control.ab_may_start)
+            refusal = control.ab_may_start(
+                rollout_state=(rollout.state if rollout is not None
+                               else STATE_IDLE),
+                replica_groups=n,
+            )
+            if refusal is not None:
+                if "rollout" in refusal:
+                    raise RolloutInProgress(refusal)
+                raise ValueError(refusal)
             params, model_state = self._load(source)
             a_idx = list(range(n - n // 2))
             b_idx = list(range(n - n // 2, n))

@@ -75,6 +75,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from distributedpytorch_tpu.obs import defs as obsm
 from distributedpytorch_tpu.obs import flight
+from distributedpytorch_tpu.serve import control
 from distributedpytorch_tpu.serve.metrics import percentile
 from distributedpytorch_tpu.serve.rollout import (
     ab_arm_for,
@@ -741,60 +742,63 @@ class Router:
         finally:
             conn.close()
 
-    def _take_over(self, reason: str) -> None:
+    def _take_over(self, decision: control.HaDecision) -> None:
         self.role = "active"
-        self.ha_epoch = max(self.ha_epoch, self._peer_epoch_seen) + 1
+        self.ha_epoch = decision.epoch
         self.takeovers += 1
         obsm.ROUTER_HA_EVENTS.labels(event="takeover").inc()
-        flight.record("router_ha", event="takeover", reason=reason,
+        flight.record("router_ha", event="takeover", reason=decision.reason,
                       epoch=self.ha_epoch)
         logger.warning("router: TOOK OVER as active (epoch %d): %s",
-                       self.ha_epoch, reason)
+                       self.ha_epoch, decision.reason)
 
-    def _demote(self, peer_epoch: int, reason: str) -> None:
+    def _demote(self, decision: control.HaDecision) -> None:
         self.role = "standby"
-        self.ha_epoch = max(self.ha_epoch, int(peer_epoch))
+        self.ha_epoch = decision.epoch
         obsm.ROUTER_HA_EVENTS.labels(event="demote").inc()
-        flight.record("router_ha", event="demote", reason=reason,
+        flight.record("router_ha", event="demote", reason=decision.reason,
                       epoch=self.ha_epoch)
         logger.warning("router: demoted to standby (epoch %d): %s",
-                       self.ha_epoch, reason)
+                       self.ha_epoch, decision.reason)
 
     def ha_once(self) -> None:
         """One HA exchange with the peer router (runs every probe
         interval, so 'takeover within one probe interval' is by
-        construction). Standby + reachable active → pull its snapshot.
-        Standby + dead active → take over on THIS missed probe. Both
-        active (a relaunched ex-active rejoining) → the higher epoch
-        keeps the role, primary wins ties. Both standby → the primary
-        promotes itself."""
+        construction). The DECISION is ``serve/control.decide_ha`` —
+        the same pure arbitration rule the protocol explorer
+        (analysis/protocol.py) exhaustively model-checks: standby +
+        reachable active → pull its snapshot; standby + dead active →
+        take over on THIS missed probe; both active (a relaunched
+        ex-active rejoining) → the higher epoch keeps the role, primary
+        wins ties; both standby → the primary promotes itself."""
         if self.peer is None:
             return
         state = self._peer_state()
-        if state is None:
-            if self.role == "standby":
-                self._take_over("active router missed a probe")
-            return
-        peer_role = state.get("role", "")
-        peer_epoch = int(state.get("epoch", 0))
-        self._peer_epoch_seen = max(self._peer_epoch_seen, peer_epoch)
-        if self.role == "active" and peer_role == "active":
-            if peer_epoch > self.ha_epoch or (
-                    peer_epoch == self.ha_epoch and not self.ha_primary):
-                self._demote(peer_epoch,
-                             "peer is active at a higher epoch")
-            return
-        if self.role == "standby" and peer_role == "standby":
-            if self.ha_primary:
-                self._take_over("both routers standby; primary promotes")
-            return
-        if self.role == "standby":
+        peer_reachable = state is not None
+        peer_role = state.get("role", "") if peer_reachable else None
+        peer_epoch = int(state.get("epoch", 0)) if peer_reachable else 0
+        decision = control.decide_ha(
+            role=self.role,
+            epoch=self.ha_epoch,
+            primary=self.ha_primary,
+            peer_epoch_seen=self._peer_epoch_seen,
+            peer_reachable=peer_reachable,
+            peer_role=peer_role,
+            peer_epoch=peer_epoch,
+        )
+        if peer_reachable:
+            self._peer_epoch_seen = max(self._peer_epoch_seen, peer_epoch)
+        if decision.action == control.HA_TAKE_OVER:
+            self._take_over(decision)
+        elif decision.action == control.HA_DEMOTE:
+            self._demote(decision)
+        elif decision.action == control.HA_SYNC:
             try:
                 self.import_state(state)
             except Exception:  # noqa: BLE001 — a malformed snapshot
                 # must not kill the probe loop; next interval retries
                 logger.exception("router: peer snapshot import failed")
-            self.ha_epoch = max(self.ha_epoch, peer_epoch)
+            self.ha_epoch = decision.epoch
 
     def _probe_loop(self) -> None:
         while not self._stop.wait(self.probe_interval_s):

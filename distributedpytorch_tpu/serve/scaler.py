@@ -52,6 +52,7 @@ from distributedpytorch_tpu.serve.control import (  # noqa: F401
     ScaleDecision,
     decide_scale,
     plan_point_for,
+    scale_hold_reason,
 )
 
 logger = logging.getLogger(__name__)
@@ -115,13 +116,16 @@ class ReplicaScaler:
         """Pure verdict: no actuation, no counters — tests drive this
         directly with a fake hint value and an explicit rate."""
         current = self.server.engine.num_replicas
-        hold_reason = None
         abtest = getattr(self.server, "abtest", None)
-        if (abtest is not None and abtest.active) or (
-                getattr(self.server, "ab_arms", None) is not None):
-            hold_reason = "replica groups pinned by a sustained A/B"
-        elif self.server.engine.versions_mixed:
-            hold_reason = "weight versions mixed (rollout in flight)"
+        # the pin rule is the pure law the protocol explorer
+        # model-checks (control.scale_hold_reason): a scaler that acts
+        # while a canary owns the groups retires the experiment's pinned
+        # replicas out from under it
+        hold_reason = scale_hold_reason(
+            ab_pinned=(abtest is not None and abtest.active) or (
+                getattr(self.server, "ab_arms", None) is not None),
+            versions_mixed=self.server.engine.versions_mixed,
+        )
         cap = self.max_replicas
         if cap is None:
             import jax

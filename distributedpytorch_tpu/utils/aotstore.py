@@ -210,6 +210,29 @@ def _note(result: str, key: str = "", detail: str = "") -> None:
     flight.record("aot_cache", **fields)
 
 
+#: Markers XLA stamps into an executable's text when any input buffer is
+#: aliased to an output (the compiled form of ``jit(...,
+#: donate_argnums=...)``). Shared with analysis/donation.py, which scans
+#: the LOWERED (pre-compile) text for the same property statically.
+DONATION_MARKERS = ("input_output_alias", "tf.aliasing_output")
+
+
+def executable_donates(compiled) -> bool:
+    """Does this compiled executable alias an input buffer into an
+    output? Such an executable frees (or overwrites) an operand on
+    every call — admitting one to the store hands every sibling
+    process a use-after-free: serve replicas re-read their weights
+    operand on each request, so the second request through a
+    rehydrated donated executable reads poisoned memory (the
+    CPU-backend SIGABRT class). Unreadable text counts as donating —
+    the store must be able to PROVE cleanliness to admit."""
+    try:
+        text = compiled.as_text()
+    except Exception:  # noqa: BLE001 — no proof, no admission
+        return True
+    return any(marker in text for marker in DONATION_MARKERS)
+
+
 class AOTStore:
     """One store directory; flat ``<key>.aotx`` entries."""
 
@@ -240,6 +263,17 @@ class AOTStore:
         ``key``. Never raises outward: a store that cannot persist
         (disk full, unserializable executable) logs a note and the
         engine simply stays uncached."""
+        if executable_donates(compiled):
+            logger.warning(
+                "aot store: refusing to admit %s — the executable "
+                "aliases an input buffer to an output (donation); a "
+                "rehydrating sibling would re-read a freed operand. "
+                "Serving continues uncached; fix the donating jit "
+                "wrapper (serve executables must lower through "
+                "serve/engine.serve_jit, which never donates)",
+                key,
+            )
+            return None
         try:
             from jax.experimental.serialize_executable import serialize
 

@@ -565,7 +565,10 @@ class TestLintRules:
     def test_suppression_list_with_spaces_covers_every_rule(self):
         # "disable=a, b" (natural comma+space style) must suppress BOTH
         # rules — the regex stopping at whitespace silently dropped the
-        # second one (review regression)
+        # second one (review regression). The listed rule that fires is
+        # absorbed; the listed rule that does NOT fire on this line is
+        # reported by the hygiene pass as stale — never re-surfaced as
+        # the rule itself.
         src = (
             "import time, jax\n"
             "def step(x):\n"
@@ -573,16 +576,23 @@ class TestLintRules:
             "# dptlint: disable=host-sync-hot-path, trace-nondeterminism\n"
             "fast = jax.jit(step)\n"
         )
-        assert lint.lint_source(src, "m.py") == []
+        findings = lint.lint_source(src, "m.py")
+        assert [f.rule for f in findings] == ["stale-suppression"]
+        assert "host-sync-hot-path" in findings[0].message
 
     def test_unknown_rule_suppression_does_not_mask(self):
+        # the typo'd rule suppresses nothing — the real finding still
+        # fires, and the hygiene pass names the typo itself
         src = (
             "import time, jax\n"
             "def step(x):\n"
             "    return x * time.time()  # dptlint: disable=other-rule\n"
             "fast = jax.jit(step)\n"
         )
-        assert len(lint.lint_source(src, "m.py")) == 1
+        findings = lint.lint_source(src, "m.py")
+        assert sorted(f.rule for f in findings) == [
+            "trace-nondeterminism", "unknown-suppression",
+        ]
 
     def test_dedupe_collapses_identical_findings(self):
         f = Finding(rule="r", where="w", message="m", layer="lint")

@@ -1,0 +1,457 @@
+"""dptverify (the ISSUE-20 passes): eval/serve contract derivation, the
+serve donation-safety pass, the control-plane protocol explorer,
+suppression hygiene, SARIF output, and the preflight runner's infra
+paths.
+
+Same contract as tests/test_analysis.py: every seeded mutation — a
+dropped eval-step reduction, a donating serve jit wrapper, a flipped
+router takeover-epoch comparison — must be flagged with an actionable
+one-line diagnostic, in under 60 s, with ZERO device execution (the
+``no_compile`` fixture makes any XLA compile raise), and the clean tree
+must pass every pass for every combo and serve variant.
+"""
+
+import json
+import subprocess
+import time
+
+import jax
+import pytest
+
+import distributedpytorch_tpu.parallel.pipeline as pipeline
+from distributedpytorch_tpu.analysis import collectives, donation, lint
+from distributedpytorch_tpu.analysis import preflight, protocol
+from distributedpytorch_tpu.analysis import Finding
+from distributedpytorch_tpu.analysis.cli import run as analyze_cli_run
+from distributedpytorch_tpu.analysis.sarif import (
+    SARIF_VERSION,
+    to_sarif,
+    write_sarif,
+)
+from distributedpytorch_tpu.serve import control
+from distributedpytorch_tpu.utils import aotstore
+
+MUTATION_BUDGET_S = 60.0
+
+
+@pytest.fixture
+def no_compile(monkeypatch):
+    """Prove zero device execution: the trace/lowering-only passes must
+    never reach XLA compilation."""
+
+    def boom(self, *a, **k):
+        raise AssertionError(
+            "analyzer compiled an executable during a trace-only check"
+        )
+
+    monkeypatch.setattr(jax.stages.Lowered, "compile", boom)
+
+
+# ---------------------------------------------------------------------------
+class TestEvalContracts:
+    def test_contract_table_has_eval_rows_for_pipeline_combos(self):
+        # the derived table: every pipeline combo carries the
+        # output-feeding eval psum over 'stage'; non-pipeline combos
+        # have no traced eval program to check
+        for key in (("MP", "gpipe"), ("MP", "1f1b"),
+                    ("DDP_MP", "gpipe"), ("DDP_MP", "1f1b")):
+            reqs = collectives.EVAL_JAXPR_CONTRACTS[key]
+            psums = [r for r in reqs if r.kind == "psum"]
+            assert psums and all("stage" in r.axes for r in psums)
+            assert any(r.grad_output for r in psums)  # output-feeding
+        assert ("DP", None) not in collectives.EVAL_JAXPR_CONTRACTS or \
+            not collectives.EVAL_JAXPR_CONTRACTS[("DP", None)]
+
+    def test_clean_pipeline_eval_step_passes(self, no_compile):
+        findings = collectives.analyze_combo("MP", "gpipe",
+                                             rank_check=False)
+        assert findings == [], "\n".join(f.line for f in findings)
+
+    def test_dropped_eval_reduction_caught(self, monkeypatch, no_compile):
+        # the seeded mutation: the pipelined eval forward returns
+        # stage-local predictions without the stage psum — dynamically
+        # this ships per-stage metrics as if they were global, silently
+        t0 = time.monotonic()
+        monkeypatch.setattr(pipeline, "_broadcast_preds",
+                            lambda preds, stage_axis: preds)
+        findings = collectives.analyze_combo("MP", "gpipe",
+                                             rank_check=False)
+        elapsed = time.monotonic() - t0
+        hits = [f for f in findings if f.rule == "comms-contract"
+                and "eval" in f.where]
+        assert hits, findings
+        msgs = " | ".join(f.message for f in hits)
+        assert "psum" in msgs and "stage" in msgs  # actionable
+        assert elapsed < MUTATION_BUDGET_S
+
+
+# ---------------------------------------------------------------------------
+class TestServeVariantTraces:
+    def test_every_variant_and_bucket_traces_collective_free(
+        self, no_compile
+    ):
+        findings, tags = collectives.analyze_serve()
+        assert findings == [], "\n".join(f.line for f in findings)
+        # 4 variants (float / int8 / pallas / int8+pallas) x 2 buckets
+        assert len(tags) == len(collectives.SERVE_VARIANTS) * \
+            len(collectives.SERVE_TRACE_BATCHES)
+        for variant in collectives.SERVE_VARIANTS:
+            assert any(variant in t for t in tags)
+
+    def test_unknown_variant_is_rejected(self):
+        with pytest.raises(ValueError):
+            collectives.trace_serve("bf16-magic")
+
+
+# ---------------------------------------------------------------------------
+class TestDonationPass:
+    def test_clean_serve_lowerings_are_donation_free(self, no_compile):
+        findings, tags = donation.analyze_donation()
+        assert findings == [], "\n".join(f.line for f in findings)
+        assert len(tags) == len(donation.SERVE_VARIANTS)
+
+    @pytest.mark.filterwarnings(
+        "ignore:Some donated buffers were not usable"
+    )
+    def test_donating_serve_jit_caught_at_lowering(
+        self, monkeypatch, no_compile
+    ):
+        # the seeded mutation: the engine's one jit wrapper starts
+        # donating its weights operand — dynamically this is the
+        # CPU-backend SIGABRT / AOT-store poisoning class, surfacing
+        # only on the second request through a replica
+        import distributedpytorch_tpu.serve.engine as engine
+
+        t0 = time.monotonic()
+        monkeypatch.setattr(
+            engine, "serve_jit",
+            lambda fn: jax.jit(fn, donate_argnums=(0,)),
+        )
+        findings, _tags = donation.analyze_donation()
+        elapsed = time.monotonic() - t0
+        assert findings, "donating serve_jit went unflagged"
+        assert all(f.rule == "serve-donation" for f in findings)
+        assert len(findings) == len(donation.SERVE_VARIANTS)
+        msgs = " | ".join(f.message for f in findings)
+        assert "donate" in msgs and "poisoned" in msgs  # actionable
+        assert elapsed < MUTATION_BUDGET_S
+
+    def test_executable_donates_three_way(self):
+        class Clean:
+            def as_text(self):
+                return "HloModule m\nROOT add = f32[2] add(p0, p1)\n"
+
+        class Donating:
+            def as_text(self):
+                return ("HloModule m, input_output_alias={ {}: (0, {}, "
+                        "may-alias) }\n")
+
+        class Unreadable:
+            def as_text(self):
+                raise RuntimeError("no text on this backend")
+
+        assert aotstore.executable_donates(Clean()) is False
+        assert aotstore.executable_donates(Donating()) is True
+        # no proof, no admission
+        assert aotstore.executable_donates(Unreadable()) is True
+
+    def test_store_refuses_donating_executable(self, tmp_path):
+        class Donating:
+            def as_text(self):
+                return "HloModule m\n  tf.aliasing_output = 0\n"
+
+        store = aotstore.AOTStore(str(tmp_path / "store"))
+        assert store.save("k1", {"jax": jax.__version__}, Donating()) \
+            is None
+        # the refusal persisted nothing a sibling could rehydrate
+        root = tmp_path / "store"
+        assert not root.exists() or not any(root.rglob("*"))
+
+
+# ---------------------------------------------------------------------------
+class TestProtocolExplorer:
+    """The control-plane model checker: exhaustive, jax-free, ms-fast.
+    Each mutation below injects a protocol bug through the same pure
+    seam the live actuators call, and must be caught with a trace."""
+
+    def test_clean_control_plane_has_no_findings(self):
+        t0 = time.monotonic()
+        findings = protocol.analyze_protocols()
+        elapsed = time.monotonic() - t0
+        assert findings == [], "\n".join(f.line for f in findings)
+        assert elapsed < 10.0  # whole exhaustive pass is near-instant
+
+    def test_flipped_takeover_epoch_comparison_caught(self):
+        # the seeded mutation: dual-active arbitration keeps the LOWER
+        # epoch — the fleet is handed to stale state
+        def flipped(**kw):
+            if kw["peer_reachable"] and kw["role"] == "active" and \
+                    kw.get("peer_role") == "active":
+                if kw.get("peer_epoch", 0) < kw["epoch"]:
+                    return control.HaDecision(
+                        control.HA_DEMOTE,
+                        max(kw["epoch"], kw.get("peer_epoch", 0)),
+                        "flipped comparison",
+                    )
+                return control.HaDecision(control.HA_HOLD, kw["epoch"],
+                                          "flipped comparison")
+            return control.decide_ha(**kw)
+
+        t0 = time.monotonic()
+        findings = protocol.explore_router_ha(flipped)
+        elapsed = time.monotonic() - t0
+        assert findings, "flipped epoch comparison went unflagged"
+        msgs = " | ".join(f.message for f in findings)
+        assert "LOWER epoch" in msgs and "[trace:" in msgs
+        assert elapsed < MUTATION_BUDGET_S
+
+    def test_unfenced_takeover_caught(self):
+        # takeover epoch forgets the +1: a relaunched ex-active at the
+        # same epoch could outrank the router that took over from it
+        def nofence(**kw):
+            d = control.decide_ha(**kw)
+            if d.action == control.HA_TAKE_OVER:
+                return control.HaDecision(
+                    control.HA_TAKE_OVER,
+                    max(kw["epoch"], kw["peer_epoch_seen"]),
+                    "no fence",
+                )
+            return d
+
+        findings = protocol.explore_router_ha(nofence)
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "does not fence" in msgs and "[trace:" in msgs
+
+    def test_deaf_standby_caught(self):
+        # a standby that never promotes on a missed probe: the fleet
+        # has no active router after the active dies
+        def deaf(**kw):
+            if not kw["peer_reachable"]:
+                return control.HaDecision(control.HA_HOLD, kw["epoch"],
+                                          "deaf standby")
+            return control.decide_ha(**kw)
+
+        findings = protocol.explore_router_ha(deaf)
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "lost-request" in msgs
+
+    def test_leaky_canary_restore_caught(self):
+        # failure edges out of canary stop restoring the canary subset:
+        # rejected weights keep serving on the canary replicas
+        def leaky(state, event):
+            step = control.rollout_transition(state, event)
+            if step.restore == control.RESTORE_CANARY:
+                return control.RolloutStep(step.state, step.outcome,
+                                           control.RESTORE_NONE)
+            return step
+
+        findings = protocol.check_rollout_machine(leaky)
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "canary subset" in msgs
+
+    def test_permissive_ab_guard_caught(self):
+        findings = protocol.explore_experiment_interleavings(
+            ab_guard_fn=lambda *, rollout_state, replica_groups: None,
+        )
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "A/B" in msgs and "canary" in msgs
+
+    def test_null_scale_hold_caught(self):
+        # the retire-while-canary interleaving: the scaler acts while
+        # weight versions are mixed
+        findings = protocol.explore_experiment_interleavings(
+            hold_fn=lambda *, ab_pinned, versions_mixed: None,
+        )
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "retire-while-canary" in msgs
+
+    def test_retire_lowest_rank_caught(self):
+        findings = protocol.explore_fleet_ranks(
+            retire_fn=lambda active: (min(active) if len(active) > 1
+                                      else None),
+        )
+        assert findings
+        msgs = " | ".join(f.message for f in findings)
+        assert "highest active rank" in msgs
+
+
+# ---------------------------------------------------------------------------
+class TestSuppressionHygiene:
+    def test_unknown_rule_suppression_reported(self):
+        findings = lint.lint_source(
+            "x = 1  # dptlint: disable=imaginary-rule\n", "m.py")
+        assert [f.rule for f in findings] == ["unknown-suppression"]
+        assert "imaginary-rule" in findings[0].message
+
+    def test_stale_suppression_reported(self):
+        # the rule exists but no longer fires on this line — the
+        # suppression is dead weight that would hide a future regression
+        findings = lint.lint_source(
+            "x = 1  # dptlint: disable=trace-nondeterminism\n", "m.py")
+        assert [f.rule for f in findings] == ["stale-suppression"]
+        assert "trace-nondeterminism" in findings[0].message
+
+    def test_live_suppression_is_silent(self):
+        src = (
+            "import time, jax\n"
+            "def step(x):\n"
+            "    return x * time.time()"
+            "  # dptlint: disable=trace-nondeterminism\n"
+            "fast = jax.jit(step)\n"
+        )
+        assert lint.lint_source(src, "m.py") == []
+
+    def test_serve_donation_ast_rule_scoped_to_serve_modules(self):
+        src = (
+            "import jax\n"
+            "def build(fwd):\n"
+            "    return jax.jit(fwd, donate_argnums=(0,))\n"
+        )
+        serve_findings = lint.lint_source(src, "serve/engine2.py")
+        assert "serve-donation" in {f.rule for f in serve_findings}
+        # donation in the training tier is the intended idiom
+        train_findings = lint.lint_source(src, "train/step.py")
+        assert "serve-donation" not in {f.rule for f in train_findings}
+
+
+# ---------------------------------------------------------------------------
+class TestSarifOutput:
+    def _findings(self):
+        return [
+            Finding(rule="trace-nondeterminism",
+                    where="distributedpytorch_tpu/serve/cli.py:412",
+                    message="wall-clock read inside a traced function",
+                    layer="lint"),
+            Finding(rule="comms-contract",
+                    where="MP/1f1b eval step",
+                    message="missing psum over ('stage',)",
+                    layer="jaxpr"),
+            Finding(rule="comms-contract",
+                    where="DDP_MP/1f1b eval step",
+                    message="missing psum over ('stage',)",
+                    layer="jaxpr"),
+        ]
+
+    def test_shape_rules_and_locations(self):
+        log = to_sarif(self._findings())
+        assert log["version"] == SARIF_VERSION == "2.1.0"
+        run = log["runs"][0]
+        assert run["tool"]["driver"]["name"] == "dptlint"
+        rules = run["tool"]["driver"]["rules"]
+        # two distinct rules, deduped, layer recorded
+        assert [r["id"] for r in rules] == ["trace-nondeterminism",
+                                           "comms-contract"]
+        assert rules[1]["properties"]["layer"] == "jaxpr"
+        results = run["results"]
+        assert len(results) == 3
+        # file-anchored finding gets a physicalLocation
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == \
+            "distributedpytorch_tpu/serve/cli.py"
+        assert loc["region"]["startLine"] == 412
+        # program-level findings carry the combo in the message instead
+        assert "locations" not in results[1]
+        assert results[1]["message"]["text"].startswith(
+            "[MP/1f1b eval step]")
+        assert results[1]["ruleIndex"] == results[2]["ruleIndex"] == 1
+        assert all(r["level"] == "error" for r in results)
+
+    def test_write_sarif_is_valid_json(self, tmp_path):
+        path = tmp_path / "out.sarif"
+        write_sarif(str(path), self._findings())
+        log = json.loads(path.read_text())
+        assert log["version"] == "2.1.0"
+        assert len(log["runs"][0]["results"]) == 3
+
+    def test_cli_emits_sarif_next_to_json(self, tmp_path):
+        report = tmp_path / "report.json"
+        sarif = tmp_path / "report.sarif"
+        rc = analyze_cli_run([
+            "--layer", "lint", "--json", str(report),
+            "--sarif", str(sarif),
+        ])
+        assert rc == 0
+        log = json.loads(sarif.read_text())
+        assert log["version"] == "2.1.0"
+        assert log["runs"][0]["results"] == []  # clean tree
+
+
+# ---------------------------------------------------------------------------
+class TestPreflightInfra:
+    """The runner's non-analysis failure modes: a preflight that cannot
+    RUN the analyzer must report infra (rc 2) — which both call sites
+    treat as proceed-with-warning — never fabricate findings."""
+
+    def test_timeout_is_infra(self, monkeypatch):
+        def fake_run(cmd, **kw):
+            raise subprocess.TimeoutExpired(cmd=cmd,
+                                            timeout=kw.get("timeout"))
+
+        monkeypatch.setattr(preflight.subprocess, "run", fake_run)
+        rc, lines = preflight.run_preflight(["MP"], ["gpipe"],
+                                            timeout=0.5)
+        assert rc == 2
+        assert "analyzer did not run" in lines[0]
+        assert "TimeoutExpired" in lines[0]
+
+    def test_oserror_is_infra(self, monkeypatch):
+        def fake_run(cmd, **kw):
+            raise OSError("exec format error")
+
+        monkeypatch.setattr(preflight.subprocess, "run", fake_run)
+        rc, lines = preflight.run_preflight(["MP"], [], timeout=5.0)
+        assert rc == 2
+        assert "analyzer did not run" in lines[0]
+
+    def test_rc1_with_garbage_stdout_is_infra(self, monkeypatch):
+        class Proc:
+            returncode = 1
+            stdout = ("Traceback (most recent call last):\n"
+                      "ModuleNotFoundError: No module named 'flax'\n")
+            stderr = ""
+
+        monkeypatch.setattr(preflight.subprocess, "run",
+                            lambda *a, **k: Proc())
+        rc, lines = preflight.run_preflight(["MP"], ["gpipe"],
+                                            timeout=5.0)
+        # a crashed interpreter exits 1 too — that must surface as
+        # infra, not as findings that would refuse a launch
+        assert rc == 2
+        assert "exited 1 without a report" in lines[0]
+        assert "flax" in lines[0]  # the tail is carried for triage
+
+    def test_rc1_with_report_formats_findings(self, monkeypatch):
+        class Proc:
+            returncode = 1
+            stdout = json.dumps({"findings": [{
+                "rule": "comms-contract",
+                "where": "MP/gpipe eval step",
+                "message": "missing psum over ('stage',)",
+            }]})
+            stderr = ""
+
+        monkeypatch.setattr(preflight.subprocess, "run",
+                            lambda *a, **k: Proc())
+        rc, lines = preflight.run_preflight(["MP"], ["gpipe"],
+                                            timeout=5.0)
+        assert rc == 1
+        assert lines == [
+            "[comms-contract] MP/gpipe eval step: "
+            "missing psum over ('stage',)",
+        ]
+
+    def test_rc1_with_empty_findings_still_refuses(self, monkeypatch):
+        class Proc:
+            returncode = 1
+            stdout = json.dumps({"findings": []})
+            stderr = ""
+
+        monkeypatch.setattr(preflight.subprocess, "run",
+                            lambda *a, **k: Proc())
+        rc, lines = preflight.run_preflight(["MP"], [], timeout=5.0)
+        assert rc == 1 and lines  # rc 1 always carries at least a line
